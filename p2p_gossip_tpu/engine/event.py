@@ -1,0 +1,124 @@
+"""Exact discrete-event gossip engine (Python).
+
+This is the NS-3 role in our framework: a message-level event-driven simulator
+with the reference's exact application semantics (p2pnode.cc):
+
+- a generation event inserts the share into the origin's seen-set
+  (p2pnode.cc:120) and broadcasts to all peers (`GossipShareToPeers`,
+  p2pnode.cc:127), counting one ``sent`` per peer;
+- a message arrival at a node that has seen the share is dropped with NO
+  counter change (p2pnode.cc:189);
+- a first-time arrival increments ``received`` and ``forwarded`` together
+  (p2pnode.cc:155-164) and re-broadcasts to ALL peers including the sender;
+- events at tick >= horizon never fire (Simulator::Stop).
+
+Time is integer ticks (one tick = the latency quantum), which is what makes
+bit-exact parity with the synchronous TPU engine (`engine.sync`) testable:
+same topology + same schedule + same integer delays => identical counters.
+
+A C++ implementation of the same loop lives in native/gossip_native.cc
+(`runtime.native`); this Python version is the always-available fallback and
+the readable specification.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+import numpy as np
+
+from p2p_gossip_tpu.models.topology import Graph
+from p2p_gossip_tpu.models.generation import Schedule
+from p2p_gossip_tpu.utils.stats import NodeStats
+
+
+def run_event_sim(
+    graph: Graph,
+    schedule: Schedule,
+    horizon_ticks: int,
+    ell_delays: np.ndarray | None = None,
+    constant_delay: int = 1,
+    coverage_slots: int | None = None,
+) -> NodeStats:
+    """Run the event-driven gossip simulation for ``horizon_ticks`` ticks.
+
+    ``ell_delays`` (aligned with ``graph.ell()``) gives per-edge integer
+    delays; otherwise every edge takes ``constant_delay`` ticks.
+
+    Returns per-node counters; if ``coverage_slots`` is set, also records each
+    listed share's first-arrival tick per node in ``stats.extra``.
+    """
+    n = graph.n
+    indptr, indices = graph.indptr, graph.indices
+    if ell_delays is not None:
+        rows, pos = graph.csr_rows_pos()
+        csr_delays = ell_delays[rows, pos].astype(np.int64)
+    else:
+        csr_delays = np.full(indices.shape[0], constant_delay, dtype=np.int64)
+
+    generated = np.zeros(n, dtype=np.int64)
+    received = np.zeros(n, dtype=np.int64)
+    forwarded = np.zeros(n, dtype=np.int64)
+    sent = np.zeros(n, dtype=np.int64)
+    seen: list[set[int]] = [set() for _ in range(n)]
+    arrival_ticks = (
+        np.full((coverage_slots, n), -1, dtype=np.int64)
+        if coverage_slots
+        else None
+    )
+
+    events_processed = 0
+    # Heap of (tick, seq, kind, node, share); kind 0 = generation, 1 = message.
+    # seq keeps ordering deterministic; same-tick duplicates resolve the same
+    # way regardless of order because dedup is order-independent within a tick
+    # (all same-tick arrivals of a share are dropped after the first).
+    heap: list[tuple[int, int, int, int, int]] = []
+    seq = 0
+    for s in range(schedule.num_shares):
+        t = int(schedule.gen_ticks[s])
+        if t < horizon_ticks:
+            heap.append((t, seq, 0, int(schedule.origins[s]), s))
+            seq += 1
+    heapq.heapify(heap)
+
+    def broadcast(node: int, share: int, now: int) -> None:
+        nonlocal seq
+        lo, hi = indptr[node], indptr[node + 1]
+        sent[node] += hi - lo
+        for e in range(lo, hi):
+            t_arr = now + int(csr_delays[e])
+            if t_arr < horizon_ticks:
+                heapq.heappush(heap, (t_arr, seq, 1, int(indices[e]), share))
+                seq += 1
+
+    while heap:
+        t, _, kind, node, share = heapq.heappop(heap)
+        events_processed += 1
+        if kind == 0:
+            generated[node] += 1
+            seen[node].add(share)
+            if arrival_ticks is not None and share < arrival_ticks.shape[0]:
+                arrival_ticks[share, node] = t
+            broadcast(node, share, t)
+        else:
+            if share in seen[node]:
+                continue
+            seen[node].add(share)
+            received[node] += 1
+            forwarded[node] += 1
+            if arrival_ticks is not None and share < arrival_ticks.shape[0]:
+                arrival_ticks[share, node] = t
+            broadcast(node, share, t)
+
+    stats = NodeStats(
+        generated=generated.astype(np.int64),
+        received=received.astype(np.int64),
+        forwarded=forwarded.astype(np.int64),
+        sent=sent.astype(np.int64),
+        processed=(generated + received).astype(np.int64),
+        degree=graph.degree.astype(np.int64),
+    )
+    stats.extra["events_processed"] = events_processed
+    if arrival_ticks is not None:
+        stats.extra["arrival_ticks"] = arrival_ticks
+    return stats

@@ -1,0 +1,66 @@
+"""Per-edge latency models.
+
+The reference assigns one constant delay to every point-to-point link
+(`ConnectNodes`, p2pnetwork.cc:110-130, default 5 ms). The TPU engine works in
+integer ticks: the simulation quantum ``tick_dt`` is the GCD-ish unit of delay
+(by default the latency itself, so constant latency == 1 tick), and each edge
+carries an integer delay in [1, max_delay]. Delays are materialized in ELL
+layout, aligned with ``Graph.ell()``, so the frontier propagation can gather
+``hist[(t - d) % D, src]`` — delay lines realized as reads into a ring of past
+frontiers rather than per-message events.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from p2p_gossip_tpu.models.topology import Graph
+
+
+def constant_delays(graph: Graph, ticks: int = 1) -> np.ndarray:
+    """Every edge has the same integer-tick delay (reference default)."""
+    if ticks < 1:
+        raise ValueError("delays must be >= 1 tick")
+    deg = graph.degree
+    dmax = int(deg.max()) if graph.n else 0
+    return np.full((graph.n, dmax), ticks, dtype=np.int32)
+
+
+def _symmetrize_edge_values(graph: Graph, undirected_vals: np.ndarray) -> np.ndarray:
+    """Expand per-undirected-edge values to ELL layout (same value in both
+    directions, matching a full-duplex link). Fully vectorized: each directed
+    CSR entry is keyed by its canonical (min, max) pair and looked up against
+    the sorted undirected edge list via searchsorted."""
+    edges = graph.edges()  # (m, 2) with src < dst, rows in sorted key order
+    n = graph.n
+    edge_keys = edges[:, 0].astype(np.int64) * n + edges[:, 1].astype(np.int64)
+    rows, pos = graph.csr_rows_pos()
+    cols = graph.indices.astype(np.int64)
+    keys = np.minimum(rows, cols) * n + np.maximum(rows, cols)
+    vals = np.asarray(undirected_vals)[np.searchsorted(edge_keys, keys)]
+    dmax = int(graph.max_degree) if n else 0
+    out = np.ones((n, dmax), dtype=np.int32)
+    out[rows, pos] = vals
+    return out
+
+
+def lognormal_delays(
+    graph: Graph,
+    mean_ticks: float = 2.0,
+    sigma: float = 0.5,
+    max_ticks: int = 8,
+    seed: int = 0,
+) -> np.ndarray:
+    """Log-normal per-edge delays in integer ticks, clipped to [1, max_ticks]
+    — the heterogeneous-latency benchmark config. Symmetric per link."""
+    rng = np.random.default_rng(seed)
+    m = graph.num_edges
+    mu = np.log(mean_ticks) - 0.5 * sigma**2
+    vals = np.clip(
+        np.round(rng.lognormal(mu, sigma, size=m)), 1, max_ticks
+    ).astype(np.int32)
+    return _symmetrize_edge_values(graph, vals)
+
+
+def max_delay(ell_delays: np.ndarray) -> int:
+    return int(ell_delays.max()) if ell_delays.size else 1

@@ -1,0 +1,213 @@
+"""Random P2P network topology builders.
+
+Rebuilds the reference topology layer
+(`P2PGossipNetworkSimulation::CreateRandomTopology`, p2pnetwork.cc:62-96) as
+array programs: instead of materializing NS-3 point-to-point links and TCP
+sockets, a builder emits a symmetric adjacency in CSR plus an ELL (padded
+dense) form that the TPU tick engine can gather over.
+
+Connectivity guarantee parity (p2pnetwork.cc:81-84): any row ``i`` with no
+sampled edge to a higher-numbered node gets a forced edge to ``i-1``
+(``(0, 1)`` for row 0) — including row ``N-1``, which always triggers the rule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# Dense O(n^2) ER sampling below this size; sparse per-row binomial above.
+_DENSE_ER_LIMIT = 4096
+
+
+@dataclasses.dataclass
+class Graph:
+    """Undirected graph in CSR + ELL forms (both directions stored).
+
+    Replaces the reference's per-link ``ConnectionInfo`` map and per-node
+    ``peers`` vectors (p2pnetwork.cc:30, p2pnode.h:32) with flat arrays.
+    """
+
+    n: int
+    indptr: np.ndarray   # (n+1,) int64 — CSR row pointers (rows = nodes)
+    indices: np.ndarray  # (nnz,) int32 — CSR neighbor ids, sorted per row
+
+    def __post_init__(self):
+        self.indptr = np.asarray(self.indptr, dtype=np.int64)
+        self.indices = np.asarray(self.indices, dtype=np.int32)
+
+    # -- derived forms -----------------------------------------------------
+
+    @property
+    def degree(self) -> np.ndarray:
+        return np.diff(self.indptr).astype(np.int32)
+
+    @property
+    def num_edges(self) -> int:
+        """Number of undirected edges (nnz / 2)."""
+        return int(self.indices.shape[0] // 2)
+
+    @property
+    def max_degree(self) -> int:
+        return int(self.degree.max()) if self.n else 0
+
+    def csr_rows_pos(self) -> tuple[np.ndarray, np.ndarray]:
+        """(rows, pos): for each CSR entry, its row id and its position within
+        the row — the coordinate map between CSR and ELL layouts. Single
+        source of truth for every CSR<->ELL conversion."""
+        deg = self.degree
+        rows = np.repeat(np.arange(self.n, dtype=np.int64), deg)
+        pos = np.arange(self.indices.shape[0], dtype=np.int64) - np.repeat(
+            self.indptr[:-1], deg
+        )
+        return rows, pos
+
+    def ell(self, pad_to: int | None = None) -> tuple[np.ndarray, np.ndarray]:
+        """ELL (padded-dense) form: ``(ell_idx, ell_mask)`` of shape (n, dmax).
+
+        ``ell_idx[i, k]`` is the k-th neighbor of node i (0-padded);
+        ``ell_mask[i, k]`` marks valid entries. This is the TPU-friendly
+        layout: the per-tick frontier propagation is a dense gather over
+        ``ell_idx`` plus an OR-reduce along the degree axis.
+        """
+        deg = self.degree
+        dmax = int(pad_to if pad_to is not None else (deg.max() if self.n else 0))
+        ell_idx = np.zeros((self.n, dmax), dtype=np.int32)
+        ell_mask = np.zeros((self.n, dmax), dtype=bool)
+        rows, pos = self.csr_rows_pos()
+        ell_idx[rows, pos] = self.indices
+        ell_mask[rows, pos] = True
+        return ell_idx, ell_mask
+
+    def edges(self) -> np.ndarray:
+        """(m, 2) array of undirected edges with src < dst."""
+        rows = np.repeat(np.arange(self.n, dtype=np.int64), self.degree)
+        mask = rows < self.indices
+        return np.stack([rows[mask], self.indices[mask]], axis=1).astype(np.int32)
+
+    def validate(self) -> None:
+        """Structural invariants (mirrors the reference's no-isolated-nodes
+        guarantee, p2pnetwork.cc:81-84)."""
+        assert self.indptr.shape == (self.n + 1,)
+        assert self.indptr[0] == 0 and self.indptr[-1] == self.indices.shape[0]
+        deg = self.degree
+        assert (deg >= 1).all(), "isolated node — connectivity guarantee violated"
+        # Symmetry: the sorted key set of (i,j) equals that of (j,i).
+        rows, _ = self.csr_rows_pos()
+        cols = self.indices.astype(np.int64)
+        fwd = np.sort(rows * self.n + cols)
+        rev = np.sort(cols * self.n + rows)
+        assert np.array_equal(fwd, rev), "adjacency not symmetric"
+
+    @staticmethod
+    def from_edges(n: int, edges: np.ndarray) -> "Graph":
+        """Build a symmetric, deduplicated CSR graph from an (m, 2) edge list."""
+        edges = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+        # Drop self-loops, canonicalize, dedup.
+        edges = edges[edges[:, 0] != edges[:, 1]]
+        lo = np.minimum(edges[:, 0], edges[:, 1])
+        hi = np.maximum(edges[:, 0], edges[:, 1])
+        keys = np.unique(lo * n + hi)
+        lo, hi = keys // n, keys % n
+        src = np.concatenate([lo, hi])
+        dst = np.concatenate([hi, lo])
+        order = np.lexsort((dst, src))
+        src, dst = src[order], dst[order]
+        indptr = np.zeros(n + 1, dtype=np.int64)
+        np.add.at(indptr, src + 1, 1)
+        np.cumsum(indptr, out=indptr)
+        return Graph(n=n, indptr=indptr, indices=dst.astype(np.int32))
+
+
+def _forced_edges(n: int, has_upper_edge: np.ndarray) -> np.ndarray:
+    """The reference connectivity fix (p2pnetwork.cc:81-84): rows with no
+    sampled edge to any j > i get a forced edge to i-1 (row 0 -> (0, 1))."""
+    forced_rows = np.flatnonzero(~has_upper_edge)
+    out = []
+    for i in forced_rows:
+        if i == 0:
+            if n > 1:
+                out.append((0, 1))
+        else:
+            out.append((i - 1, i))
+    return np.array(out, dtype=np.int64).reshape(-1, 2)
+
+
+def erdos_renyi(n: int, p: float, seed: int = 0) -> Graph:
+    """Erdős–Rényi G(n, p) with the reference's connectivity fix.
+
+    Parity target: CreateRandomTopology (p2pnetwork.cc:62-96) — upper-triangle
+    Bernoulli(p) sampling plus forced edges. Dense sampling for small n;
+    per-row binomial sampling (identical distribution) for large n so that
+    million-node graphs build without an O(n^2) bit matrix.
+    """
+    if n <= 0:
+        raise ValueError("n must be positive")
+    rng = np.random.default_rng(seed)
+    if n <= _DENSE_ER_LIMIT:
+        tri = np.triu(rng.random((n, n)) < p, k=1)
+        src, dst = np.nonzero(tri)
+        has_upper = tri.any(axis=1)
+        edges = np.stack([src, dst], axis=1)
+    else:
+        counts = rng.binomial(np.maximum(n - 1 - np.arange(n), 0), p)
+        has_upper = counts > 0
+        srcs, dsts = [], []
+        for i in np.flatnonzero(counts):
+            k = counts[i]
+            cols = rng.choice(n - 1 - i, size=k, replace=False) + i + 1
+            srcs.append(np.full(k, i, dtype=np.int64))
+            dsts.append(cols.astype(np.int64))
+        edges = (
+            np.stack([np.concatenate(srcs), np.concatenate(dsts)], axis=1)
+            if srcs
+            else np.zeros((0, 2), dtype=np.int64)
+        )
+    forced = _forced_edges(n, has_upper)
+    if forced.size:
+        edges = np.concatenate([edges, forced], axis=0)
+    return Graph.from_edges(n, edges)
+
+
+def barabasi_albert(n: int, m: int = 3, seed: int = 0, batch: int = 1024) -> Graph:
+    """Barabási–Albert preferential attachment (scale-free), m edges per node.
+
+    Beyond-reference topology family for the skewed-degree benchmark configs.
+    Uses the repeated-endpoint array trick; nodes are attached in batches
+    (preferential weights frozen per batch) so million-node graphs build in
+    vectorized numpy rather than a per-node Python loop.
+    """
+    if n <= m:
+        raise ValueError("n must exceed m")
+    rng = np.random.default_rng(seed)
+    # Seed graph: ring over the first m+1 nodes.
+    seed_nodes = np.arange(m + 1)
+    edges = [np.stack([seed_nodes, np.roll(seed_nodes, -1)], axis=1)]
+    # Endpoint pool: each edge contributes both endpoints -> degree-weighted.
+    pool = [edges[0].ravel()]
+    next_node = m + 1
+    while next_node < n:
+        b = min(batch, n - next_node)
+        new_nodes = np.arange(next_node, next_node + b)
+        flat_pool = np.concatenate(pool)
+        targets = flat_pool[rng.integers(0, flat_pool.shape[0], size=(b, m))]
+        batch_edges = np.stack(
+            [np.repeat(new_nodes, m), targets.ravel()], axis=1
+        )
+        edges.append(batch_edges)
+        pool.append(batch_edges.ravel())
+        next_node += b
+    return Graph.from_edges(n, np.concatenate(edges, axis=0))
+
+
+def ring_graph(n: int) -> Graph:
+    """Ring topology — deterministic diameter, used by parity/latency tests."""
+    nodes = np.arange(n, dtype=np.int64)
+    return Graph.from_edges(n, np.stack([nodes, (nodes + 1) % n], axis=1))
+
+
+def complete_graph(n: int) -> Graph:
+    """Fully connected topology (single-hop flood)."""
+    src, dst = np.nonzero(np.triu(np.ones((n, n), dtype=bool), k=1))
+    return Graph.from_edges(n, np.stack([src, dst], axis=1))

@@ -1,0 +1,62 @@
+"""Bitmask frontier primitives.
+
+The reference's per-node ``std::unordered_set<uint32_t> processedShares``
+(p2pnode.h:38) becomes a dense (nodes x shares) bitmask packed into uint32
+words: share slot ``s`` lives at word ``s // 32``, bit ``s % 32``. Set
+membership, insertion, and counting collapse into vectorized bitwise ops and
+``lax.population_count`` — exactly the shapes the VPU wants.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+WORD_BITS = 32
+
+
+def num_words(num_shares: int) -> int:
+    return (num_shares + WORD_BITS - 1) // WORD_BITS
+
+
+def popcount_rows(words: jnp.ndarray) -> jnp.ndarray:
+    """Per-row set-bit count: (N, W) uint32 -> (N,) int32.
+
+    Implements the counter updates (sharesReceived etc., p2pnode.cc:157-163):
+    the number of shares a node newly processed this tick.
+    """
+    return jnp.sum(
+        lax.population_count(words).astype(jnp.int32), axis=-1
+    )
+
+
+def slot_scatter(
+    n_nodes: int,
+    n_words: int,
+    rows: jnp.ndarray,
+    slots: jnp.ndarray,
+    active: jnp.ndarray,
+) -> jnp.ndarray:
+    """Scatter share slots into a fresh (N, W) bitmask.
+
+    ``rows[s]`` is the node, ``slots[s]`` the share slot, ``active[s]`` whether
+    the event fires. Distinct slots map to distinct bits, so scatter-add is
+    scatter-OR. This realizes `GenerateAndGossipShare`'s seen-set insert
+    (p2pnode.cc:120) for all nodes at once.
+    """
+    word = (slots // WORD_BITS).astype(jnp.int32)
+    bit = (slots % WORD_BITS).astype(jnp.uint32)
+    vals = jnp.where(active, jnp.uint32(1) << bit, jnp.uint32(0))
+    out = jnp.zeros((n_nodes, n_words), dtype=jnp.uint32)
+    return out.at[rows, word].add(vals)
+
+
+def coverage_per_slot(seen: jnp.ndarray, n_slots: int) -> jnp.ndarray:
+    """Per-share coverage: (N, W) seen-bitmask -> (S,) int32 node counts.
+
+    Drives the time-to-99%-coverage metric from BASELINE.json.
+    """
+    n_words = seen.shape[-1]
+    bits = (seen[..., None] >> jnp.arange(WORD_BITS, dtype=jnp.uint32)) & jnp.uint32(1)
+    counts = jnp.sum(bits.astype(jnp.int32), axis=0).reshape(n_words * WORD_BITS)
+    return counts[:n_slots]

@@ -1,0 +1,118 @@
+"""Per-node statistics and reporting.
+
+Mirrors the reference's counter set (p2pnode.h:40-43) and the exact report
+formats of `PrintStatistics` (p2pnetwork.cc:253-285) and
+`PrintPeriodicStats` (p2pnetwork.cc:231-250), so a user of the reference can
+diff outputs line-for-line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+from typing import Any
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class NodeStats:
+    """Per-node counter vectors — one array column per reference counter."""
+
+    generated: np.ndarray  # sharesGenerated  (p2pnode.cc:118)
+    received: np.ndarray   # sharesReceived   (p2pnode.cc:157)
+    forwarded: np.ndarray  # sharesForwarded  (p2pnode.cc:163)
+    sent: np.ndarray       # sharesSent       (p2pnode.cc:145)
+    processed: np.ndarray  # processedShares.size() (p2pnode.cc:241)
+    degree: np.ndarray     # peers.size()
+    extra: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def n(self) -> int:
+        return int(self.generated.shape[0])
+
+    def totals(self) -> dict[str, int]:
+        return {
+            "generated": int(self.generated.sum()),
+            "received": int(self.received.sum()),
+            "forwarded": int(self.forwarded.sum()),
+            "sent": int(self.sent.sum()),
+            "processed": int(self.processed.sum()),
+            "connections": int(self.degree.sum()),
+        }
+
+    def check_conservation(self) -> None:
+        """Invariants implied by the reference semantics (see SURVEY.md §1)."""
+        assert (self.received == self.forwarded).all(), "received != forwarded"
+        assert (self.processed == self.generated + self.received).all()
+        assert (self.sent == (self.generated + self.forwarded) * self.degree).all()
+
+    def __add__(self, other: "NodeStats") -> "NodeStats":
+        """Chunk-wise accumulation (shares are independent, counters add).
+        Summable ``extra`` entries are combined; array-valued ones are kept
+        only when a single operand carries them."""
+        assert np.array_equal(self.degree, other.degree), "stats from different graphs"
+        out = NodeStats(
+            generated=self.generated + other.generated,
+            received=self.received + other.received,
+            forwarded=self.forwarded + other.forwarded,
+            sent=self.sent + other.sent,
+            processed=self.processed + other.processed,
+            degree=self.degree,
+        )
+        for key in set(self.extra) | set(other.extra):
+            a, b = self.extra.get(key), other.extra.get(key)
+            if a is not None and b is not None:
+                if np.isscalar(a) and np.isscalar(b):
+                    out.extra[key] = a + b
+                # two array-valued entries (e.g. arrival_ticks for different
+                # share chunks) have no well-defined merge — drop them.
+            else:
+                out.extra[key] = a if a is not None else b
+        return out
+
+    def equal_counts(self, other: "NodeStats") -> bool:
+        return bool(
+            (self.generated == other.generated).all()
+            and (self.received == other.received).all()
+            and (self.forwarded == other.forwarded).all()
+            and (self.sent == other.sent).all()
+            and (self.processed == other.processed).all()
+        )
+
+
+def format_final_statistics(stats: NodeStats, per_node: bool = True) -> str:
+    """The `PrintStatistics` report (p2pnetwork.cc:253-285), byte-for-byte
+    field layout (socket connections == peer count in a healthy run)."""
+    out = io.StringIO()
+    out.write("=== P2P Gossip Network Simulation Statistics ===\n")
+    if per_node:
+        for i in range(stats.n):
+            out.write(
+                f"Node {i}: Generated {stats.generated[i]}"
+                f", Received {stats.received[i]}"
+                f", Forwarded {stats.forwarded[i]}"
+                f", Total sent {stats.sent[i]}"
+                f", Total processed {stats.processed[i]}"
+                f", Peer count {stats.degree[i]}"
+                f", Socket connections {stats.degree[i]}\n"
+            )
+    t = stats.totals()
+    out.write(f"Total shares generated: {t['generated']}\n")
+    out.write(f"Total shares received: {t['received']}\n")
+    out.write(f"Total shares forwarded: {t['forwarded']}\n")
+    out.write(f"Total shares sent: {t['sent']}\n")
+    out.write(f"Total socket connections: {t['connections']}\n")
+    return out.getvalue()
+
+
+def format_periodic_stats(stats: NodeStats, sim_time: float) -> str:
+    """The `PrintPeriodicStats` report (p2pnetwork.cc:231-250)."""
+    t = stats.totals()
+    avg = t["processed"] // max(stats.n, 1)
+    return (
+        f"=== Periodic Stats at {sim_time:g}s ===\n"
+        f"Total shares generated: {t['generated']}\n"
+        f"Average shares per node: {avg}\n"
+        f"Total socket connections: {t['connections']}\n"
+    )

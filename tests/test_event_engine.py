@@ -1,0 +1,97 @@
+"""Event-driven engine tests: reference semantics and conservation laws."""
+
+import numpy as np
+
+from p2p_gossip_tpu.engine.event import run_event_sim
+from p2p_gossip_tpu.models.generation import (
+    Schedule,
+    single_share_schedule,
+    uniform_renewal_schedule,
+)
+from p2p_gossip_tpu.models.latency import lognormal_delays
+from p2p_gossip_tpu.models.topology import complete_graph, erdos_renyi, ring_graph
+from p2p_gossip_tpu.utils.stats import format_final_statistics
+
+
+def test_single_share_full_coverage():
+    g = ring_graph(10)
+    sched = single_share_schedule(10, origin=0, tick=0)
+    stats = run_event_sim(g, sched, horizon_ticks=100, coverage_slots=1)
+    # Everyone except origin receives exactly once.
+    assert stats.generated[0] == 1
+    assert stats.received.sum() == 9
+    stats.check_conservation()
+    arr = stats.extra["arrival_ticks"][0]
+    # Ring: arrival tick == hop distance.
+    want = np.minimum(np.arange(10), 10 - np.arange(10))
+    np.testing.assert_array_equal(arr, want)
+
+
+def test_horizon_cuts_flood():
+    g = ring_graph(20)
+    sched = single_share_schedule(20, origin=0, tick=0)
+    stats = run_event_sim(g, sched, horizon_ticks=4)
+    # Only nodes within 3 hops (ticks 1..3) received.
+    assert stats.received.sum() == 6
+    stats.check_conservation()
+
+
+def test_duplicate_suppression_on_complete_graph():
+    g = complete_graph(8)
+    sched = single_share_schedule(8, origin=3, tick=0)
+    stats = run_event_sim(g, sched, horizon_ticks=10)
+    # One hop floods everyone; every later copy is dropped without counting.
+    assert stats.received.sum() == 7
+    assert (stats.received <= 1).all()
+    stats.check_conservation()
+    # sent: origin sends 7; each receiver re-broadcasts to 7 (incl. sender).
+    assert stats.sent.sum() == 7 * 8
+
+
+def test_conservation_random_config():
+    g = erdos_renyi(50, 0.1, seed=6)
+    sched = uniform_renewal_schedule(50, sim_time=30.0, tick_dt=0.005, seed=6)
+    stats = run_event_sim(g, sched, horizon_ticks=int(30.0 / 0.005))
+    assert stats.generated.sum() == sched.num_shares
+    stats.check_conservation()
+    # On a connected graph with ticks to spare, every share reaches everyone:
+    # received per share = n - 1.
+    assert stats.received.sum() <= sched.num_shares * (g.n - 1)
+
+
+def test_generated_matches_schedule_bincount():
+    g = erdos_renyi(30, 0.2, seed=1)
+    sched = uniform_renewal_schedule(30, sim_time=20.0, tick_dt=0.005, seed=1)
+    stats = run_event_sim(g, sched, horizon_ticks=int(20.0 / 0.005))
+    np.testing.assert_array_equal(
+        stats.generated, sched.generated_per_node().astype(np.int64)
+    )
+
+
+def test_heterogeneous_delays_slow_the_flood():
+    g = ring_graph(12)
+    fast = run_event_sim(
+        g, single_share_schedule(12), horizon_ticks=100, coverage_slots=1
+    )
+    delays = lognormal_delays(g, mean_ticks=3.0, sigma=0.3, max_ticks=6, seed=2)
+    slow = run_event_sim(
+        g,
+        single_share_schedule(12),
+        horizon_ticks=300,
+        ell_delays=delays,
+        coverage_slots=1,
+    )
+    a_fast = fast.extra["arrival_ticks"][0]
+    a_slow = slow.extra["arrival_ticks"][0]
+    assert (a_slow >= a_fast).all()
+    assert a_slow.sum() > a_fast.sum()
+
+
+def test_final_statistics_format():
+    g = ring_graph(3)
+    stats = run_event_sim(g, single_share_schedule(3), horizon_ticks=10)
+    text = format_final_statistics(stats)
+    assert "=== P2P Gossip Network Simulation Statistics ===" in text
+    assert "Node 0: Generated 1, Received 0, Forwarded 0" in text
+    assert "Total shares generated: 1" in text
+    assert text.count("Peer count 2") == 3
