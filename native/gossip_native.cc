@@ -1,0 +1,248 @@
+// Native runtime for p2p_gossip_tpu: discrete-event gossip engine and graph
+// builders, C ABI for ctypes binding (runtime/native.py).
+//
+// This fills the role NS-3's C++ core plays in the reference
+// (/root/reference): a binary-heap event scheduler driving the gossip
+// app-layer semantics of p2pnode.cc —
+//   * generation inserts into the origin's seen-set and broadcasts to all
+//     peers, counting one `sent` per peer (GenerateAndGossipShare /
+//     GossipShareToPeers, p2pnode.cc:106-153);
+//   * a first-time arrival counts received+forwarded together and
+//     re-broadcasts to ALL peers including the sender (ReceiveShare,
+//     p2pnode.cc:155-165);
+//   * duplicate arrivals are dropped with no counter change
+//     (HandleRead, p2pnode.cc:189);
+//   * nothing fires at tick >= horizon (Simulator::Stop).
+// Counters are bit-exact with engine/event.py (the Python specification) and
+// with the synchronous TPU engine.
+//
+// Build: make -C native   (-> libgossip_native.so)
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <random>
+#include <vector>
+
+namespace {
+
+// Heap event: (tick, payload). kind bit 63; node bits 62..32; share bits 31..0.
+using Event = std::pair<int64_t, uint64_t>;
+constexpr uint64_t kGenFlag = 1ull << 63;
+
+inline uint64_t payload(bool gen, int64_t node, int64_t share) {
+  return (gen ? kGenFlag : 0) | (static_cast<uint64_t>(node) << 32) |
+         static_cast<uint32_t>(share);
+}
+
+struct SeenSet {
+  // Flat (n x words) bitset: the per-node processedShares set (p2pnode.h:38).
+  std::vector<uint64_t> bits;
+  int64_t words;
+  SeenSet(int64_t n, int64_t num_shares)
+      : bits(static_cast<size_t>(n) * ((num_shares + 63) / 64), 0),
+        words((num_shares + 63) / 64) {}
+  bool test_and_set(int64_t node, int64_t share) {
+    uint64_t& w = bits[node * words + (share >> 6)];
+    const uint64_t m = 1ull << (share & 63);
+    const bool had = w & m;
+    w |= m;
+    return had;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Runs the event-driven simulation. Returns the number of events processed
+// (heap pops), the metric NS-3-style engines are measured by. Snapshot
+// arrays may be null when num_snapshots == 0; boundaries must be sorted
+// ascending, and each snapshot is taken the moment simulated time reaches
+// its tick (PrintPeriodicStats parity).
+int64_t gossip_run_event_sim(
+    int64_t n, const int64_t* indptr, const int32_t* indices,
+    const int32_t* csr_delays, int64_t num_shares, const int32_t* origins,
+    const int32_t* gen_ticks, int64_t horizon,
+    int64_t num_snapshots, const int64_t* snapshot_ticks,
+    int64_t* snap_generated, int64_t* snap_processed,
+    int64_t* out_generated, int64_t* out_received, int64_t* out_sent) {
+  std::fill(out_generated, out_generated + n, 0);
+  std::fill(out_received, out_received + n, 0);
+  std::fill(out_sent, out_sent + n, 0);
+
+  std::priority_queue<Event, std::vector<Event>, std::greater<Event>> heap;
+  for (int64_t s = 0; s < num_shares; ++s) {
+    if (gen_ticks[s] < horizon) {
+      heap.emplace(gen_ticks[s], payload(true, origins[s], s));
+    }
+  }
+
+  SeenSet seen(n, num_shares);
+  int64_t events = 0;
+  int64_t total_generated = 0, total_received = 0;
+  int64_t snap_i = 0;
+
+  auto take_snapshots = [&](int64_t now) {
+    while (snap_i < num_snapshots && snapshot_ticks[snap_i] <= now) {
+      snap_generated[snap_i] = total_generated;
+      snap_processed[snap_i] = total_generated + total_received;
+      ++snap_i;
+    }
+  };
+
+  auto broadcast = [&](int64_t node, int64_t share, int64_t now) {
+    const int64_t lo = indptr[node], hi = indptr[node + 1];
+    out_sent[node] += hi - lo;
+    for (int64_t e = lo; e < hi; ++e) {
+      const int64_t t_arr = now + csr_delays[e];
+      if (t_arr < horizon) {
+        heap.emplace(t_arr, payload(false, indices[e], share));
+      }
+    }
+  };
+
+  while (!heap.empty()) {
+    const auto [t, p] = heap.top();
+    heap.pop();
+    take_snapshots(t);
+    ++events;
+    const int64_t node = (p >> 32) & 0x7fffffff;
+    const int64_t share = static_cast<uint32_t>(p);
+    if (p & kGenFlag) {
+      ++out_generated[node];
+      ++total_generated;
+      seen.test_and_set(node, share);
+      broadcast(node, share, t);
+    } else if (!seen.test_and_set(node, share)) {
+      ++out_received[node];
+      ++total_received;
+      broadcast(node, share, t);
+    }
+  }
+  take_snapshots(horizon);
+  return events;
+}
+
+namespace {
+
+// Shared tail for the builders: symmetrize + dedup + CSR. Returns nnz, or
+// -(needed) if `cap` is too small.
+int64_t finalize_csr(int64_t n, std::vector<std::pair<int64_t, int64_t>>& und,
+                     int64_t* out_indptr, int32_t* out_indices, int64_t cap) {
+  for (auto& e : und) {
+    if (e.first > e.second) std::swap(e.first, e.second);
+  }
+  std::sort(und.begin(), und.end());
+  und.erase(std::unique(und.begin(), und.end()), und.end());
+  // Drop self loops.
+  und.erase(std::remove_if(und.begin(), und.end(),
+                           [](const auto& e) { return e.first == e.second; }),
+            und.end());
+  const int64_t nnz = static_cast<int64_t>(und.size()) * 2;
+  if (nnz > cap) return -nnz;
+  std::vector<int64_t> deg(n, 0);
+  for (const auto& e : und) {
+    ++deg[e.first];
+    ++deg[e.second];
+  }
+  out_indptr[0] = 0;
+  for (int64_t i = 0; i < n; ++i) out_indptr[i + 1] = out_indptr[i] + deg[i];
+  std::vector<int64_t> cursor(out_indptr, out_indptr + n);
+  for (const auto& e : und) {
+    out_indices[cursor[e.first]++] = static_cast<int32_t>(e.second);
+    out_indices[cursor[e.second]++] = static_cast<int32_t>(e.first);
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    std::sort(out_indices + out_indptr[i], out_indices + out_indptr[i + 1]);
+  }
+  return nnz;
+}
+
+}  // namespace
+
+// Erdős–Rényi G(n, p) with the reference's connectivity rule
+// (CreateRandomTopology, p2pnetwork.cc:62-96): upper-triangle Bernoulli(p)
+// sampled per-row as Binomial(n-1-i, p) draws of distinct columns, then any
+// row with no higher-numbered edge gets a forced edge to i-1 ((0,1) for 0).
+int64_t gossip_build_er(int64_t n, double p, uint64_t seed, int64_t* out_indptr,
+                        int32_t* out_indices, int64_t cap) {
+  std::mt19937_64 rng(seed);
+  std::vector<std::pair<int64_t, int64_t>> und;
+  und.reserve(static_cast<size_t>(p * n * (n - 1) / 2 + n + 16));
+  std::vector<char> row_scratch;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t range = n - 1 - i;
+    int64_t k = 0;
+    if (range > 0 && p > 0.0) {
+      std::binomial_distribution<int64_t> bin(range, p);
+      k = bin(rng);
+    }
+    if (k > 0) {
+      if (k * 3 >= range) {
+        // Dense row: Bernoulli by rejection-free selection of k of `range`.
+        row_scratch.assign(range, 0);
+        std::fill(row_scratch.begin(), row_scratch.begin() + k, 1);
+        std::shuffle(row_scratch.begin(), row_scratch.end(), rng);
+        for (int64_t j = 0; j < range; ++j) {
+          if (row_scratch[j]) und.emplace_back(i, i + 1 + j);
+        }
+      } else {
+        // Sparse row: Floyd's algorithm for k distinct values in [0, range).
+        std::vector<int64_t> picked;
+        picked.reserve(k);
+        for (int64_t j = range - k; j < range; ++j) {
+          std::uniform_int_distribution<int64_t> u(0, j);
+          int64_t v = u(rng);
+          if (std::find(picked.begin(), picked.end(), v) != picked.end()) {
+            v = j;
+          }
+          picked.push_back(v);
+        }
+        for (int64_t v : picked) und.emplace_back(i, i + 1 + v);
+      }
+    } else {
+      // Forced edge (p2pnetwork.cc:81-84).
+      if (i == 0) {
+        if (n > 1) und.emplace_back(0, 1);
+      } else {
+        und.emplace_back(i - 1, i);
+      }
+    }
+  }
+  return finalize_csr(n, und, out_indptr, out_indices, cap);
+}
+
+// Exact Barabási–Albert preferential attachment: m edges per new node, seed
+// ring over the first m+1 nodes, targets drawn degree-proportionally from the
+// repeated-endpoint pool (per-node loop is cheap in C++; the Python builder
+// batches as an approximation).
+int64_t gossip_build_ba(int64_t n, int64_t m, uint64_t seed,
+                        int64_t* out_indptr, int32_t* out_indices,
+                        int64_t cap) {
+  if (n <= m || m < 1) return -1;
+  std::mt19937_64 rng(seed);
+  std::vector<std::pair<int64_t, int64_t>> und;
+  und.reserve(static_cast<size_t>(n) * m + m + 2);
+  std::vector<int64_t> pool;
+  pool.reserve(2 * (static_cast<size_t>(n) * m + m + 2));
+  for (int64_t i = 0; i <= m; ++i) {
+    const int64_t j = (i + 1) % (m + 1);
+    und.emplace_back(i, j);
+    pool.push_back(i);
+    pool.push_back(j);
+  }
+  for (int64_t v = m + 1; v < n; ++v) {
+    for (int64_t e = 0; e < m; ++e) {
+      std::uniform_int_distribution<size_t> u(0, pool.size() - 1);
+      const int64_t target = pool[u(rng)];
+      und.emplace_back(v, target);
+      pool.push_back(v);
+      pool.push_back(target);
+    }
+  }
+  return finalize_csr(n, und, out_indptr, out_indices, cap);
+}
+
+}  // extern "C"

@@ -1,0 +1,6 @@
+"""``python -m p2p_gossip_tpu`` — the simulation CLI (reference entry point:
+p2pnetwork.cc:289)."""
+
+from p2p_gossip_tpu.utils.cli import main
+
+main()

@@ -39,6 +39,7 @@ def run_event_sim(
     ell_delays: np.ndarray | None = None,
     constant_delay: int = 1,
     coverage_slots: int | None = None,
+    snapshot_ticks: list[int] | None = None,
 ) -> NodeStats:
     """Run the event-driven gossip simulation for ``horizon_ticks`` ticks.
 
@@ -91,8 +92,28 @@ def run_event_sim(
                 heapq.heappush(heap, (t_arr, seq, 1, int(indices[e]), share))
                 seq += 1
 
+    # Periodic-stats snapshots (PrintPeriodicStats, p2pnetwork.cc:231):
+    # totals captured the moment simulated time crosses each boundary.
+    snapshots: list[dict] = []
+    boundaries = sorted(snapshot_ticks) if snapshot_ticks else []
+    bi = 0
+
+    def take_snapshots(now: int) -> None:
+        nonlocal bi
+        while bi < len(boundaries) and boundaries[bi] <= now:
+            snapshots.append(
+                {
+                    "tick": boundaries[bi],
+                    "generated": int(generated.sum()),
+                    "processed": int(generated.sum() + received.sum()),
+                    "connections": int(graph.degree.sum()),
+                }
+            )
+            bi += 1
+
     while heap:
         t, _, kind, node, share = heapq.heappop(heap)
+        take_snapshots(t)
         events_processed += 1
         if kind == 0:
             generated[node] += 1
@@ -118,7 +139,10 @@ def run_event_sim(
         processed=(generated + received).astype(np.int64),
         degree=graph.degree.astype(np.int64),
     )
+    take_snapshots(horizon_ticks)
     stats.extra["events_processed"] = events_processed
+    if boundaries:
+        stats.extra["snapshots"] = snapshots
     if arrival_ticks is not None:
         stats.extra["arrival_ticks"] = arrival_ticks
     return stats

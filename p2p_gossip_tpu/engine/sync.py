@@ -1,0 +1,292 @@
+"""Synchronous TPU tick engine — the flagship execution path.
+
+This replaces the NS-3 event loop (`Simulator::Schedule`/`Run`) with a
+synchronous graph message-passing simulation designed for XLA:
+
+- one **tick** delivers every in-flight message at once: a gather-OR over the
+  ELL adjacency reading a ring buffer of past frontiers (`ops.ell.propagate`)
+  — per-edge latency as delay *lines*, not per-message events;
+- the per-node seen-set (p2pnode.h:38) is a (N x S/32) uint32 bitmask;
+- generation events (`GenerateAndGossipShare`, p2pnode.cc:106) are
+  pre-sampled host-side and scattered into the frontier at their tick;
+- counters (p2pnode.h:40-43) update via `lax.population_count` each tick;
+- time advances under `lax.while_loop` with a convergence predicate (the
+  chunk ends as soon as no message is in flight and no generation is
+  pending), or under `lax.scan` when per-tick coverage history is recorded.
+
+Arbitrary total share counts are processed in fixed-size chunks — shares are
+independent, counters are additive — so every XLA compilation sees static
+shapes and one compiled step serves every chunk.
+
+Semantics are tick-exact against the event engine (`engine.event`): same
+graph + schedule + integer delays => identical per-node counters. That is
+the "NS-3 stats parity" axis from BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2p_gossip_tpu.models.generation import Schedule
+from p2p_gossip_tpu.models.topology import Graph
+from p2p_gossip_tpu.ops import bitmask
+from p2p_gossip_tpu.ops.ell import DEFAULT_DEGREE_BLOCK, propagate
+from p2p_gossip_tpu.utils.stats import NodeStats
+
+DEFAULT_CHUNK_SIZE = 512
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceGraph:
+    """Graph + latency model staged onto the device in ELL layout."""
+
+    n: int
+    ell_idx: jnp.ndarray    # (N, dmax) int32
+    ell_delay: jnp.ndarray  # (N, dmax) int32, >= 1
+    ell_mask: jnp.ndarray   # (N, dmax) bool
+    degree: jnp.ndarray     # (N,) int32
+    ring_size: int          # D = max delay + 1
+
+    @staticmethod
+    def build(
+        graph: Graph,
+        ell_delays: np.ndarray | None = None,
+        constant_delay: int = 1,
+    ) -> "DeviceGraph":
+        ell_idx, ell_mask = graph.ell()
+        if ell_delays is None:
+            ell_delays = np.full(ell_idx.shape, constant_delay, dtype=np.int32)
+        dmax_delay = int(ell_delays.max()) if ell_delays.size else 1
+        return DeviceGraph(
+            n=graph.n,
+            ell_idx=jnp.asarray(ell_idx, dtype=jnp.int32),
+            ell_delay=jnp.asarray(ell_delays, dtype=jnp.int32),
+            ell_mask=jnp.asarray(ell_mask),
+            degree=jnp.asarray(graph.degree, dtype=jnp.int32),
+            ring_size=dmax_delay + 1,
+        )
+
+
+# Pytree registration: arrays are leaves, (n, ring_size) ride along as static
+# aux data — so a DeviceGraph passes straight through jit/shard_map.
+jax.tree_util.register_pytree_node(
+    DeviceGraph,
+    lambda dg: (
+        (dg.ell_idx, dg.ell_delay, dg.ell_mask, dg.degree),
+        (dg.n, dg.ring_size),
+    ),
+    lambda aux, ch: DeviceGraph(
+        n=aux[0], ell_idx=ch[0], ell_delay=ch[1], ell_mask=ch[2],
+        degree=ch[3], ring_size=aux[1],
+    ),
+)
+
+
+def _tick_body(dg: DeviceGraph, block: int, state, origins, slots, gen_ticks):
+    """One synchronous tick. state = (t, seen, hist, received, sent)."""
+    t, seen, hist, received, sent = state
+    n, w = seen.shape
+    arrivals = propagate(
+        hist, t, dg.ell_idx, dg.ell_delay, dg.ell_mask,
+        ring_size=dg.ring_size, block=block,
+    )
+    gen_active = gen_ticks == t
+    gen_bits = bitmask.slot_scatter(n, w, origins, slots, gen_active)
+    gen_cnt = (
+        jnp.zeros((n,), dtype=jnp.int32)
+        .at[origins]
+        .add(gen_active.astype(jnp.int32))
+    )
+    newly = arrivals & ~seen
+    newly_cnt = bitmask.popcount_rows(newly)
+    seen = seen | arrivals | gen_bits
+    received = received + newly_cnt
+    sent = sent + (newly_cnt + gen_cnt) * dg.degree
+    hist = hist.at[jnp.mod(t, dg.ring_size)].set(newly | gen_bits)
+    return (t + 1, seen, hist, received, sent)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("chunk_size", "horizon", "block")
+)
+def _run_chunk_while(
+    dg: DeviceGraph,
+    origins: jnp.ndarray,    # (S,) int32
+    gen_ticks: jnp.ndarray,  # (S,) int32 (>= horizon entries never fire)
+    t_start: jnp.ndarray,    # scalar int32
+    last_gen: jnp.ndarray,   # scalar int32
+    *,
+    chunk_size: int,
+    horizon: int,
+    block: int,
+):
+    """Run one share chunk to quiescence (or the horizon) under while_loop."""
+    n, w = dg.n, bitmask.num_words(chunk_size)
+    slots = jnp.arange(chunk_size, dtype=jnp.int32)
+    state = (
+        t_start,
+        jnp.zeros((n, w), dtype=jnp.uint32),
+        jnp.zeros((dg.ring_size, n, w), dtype=jnp.uint32),
+        jnp.zeros((n,), dtype=jnp.int32),
+        jnp.zeros((n,), dtype=jnp.int32),
+    )
+
+    def cond(state):
+        t, _, hist, _, _ = state
+        in_flight = jnp.any(hist != 0)
+        pending = t <= last_gen
+        return (t < horizon) & (in_flight | pending)
+
+    def body(state):
+        return _tick_body(dg, block, state, origins, slots, gen_ticks)
+
+    t, seen, hist, received, sent = jax.lax.while_loop(cond, body, state)
+    return seen, received, sent
+
+
+@functools.partial(
+    jax.jit, static_argnames=("chunk_size", "horizon", "block")
+)
+def _run_chunk_scan(
+    dg: DeviceGraph,
+    origins: jnp.ndarray,
+    gen_ticks: jnp.ndarray,
+    *,
+    chunk_size: int,
+    horizon: int,
+    block: int,
+):
+    """Fixed-horizon scan from t=0 recording per-tick coverage (S,) —
+    drives the time-to-coverage metrics."""
+    n, w = dg.n, bitmask.num_words(chunk_size)
+    slots = jnp.arange(chunk_size, dtype=jnp.int32)
+    state = (
+        jnp.zeros((), dtype=jnp.int32),
+        jnp.zeros((n, w), dtype=jnp.uint32),
+        jnp.zeros((dg.ring_size, n, w), dtype=jnp.uint32),
+        jnp.zeros((n,), dtype=jnp.int32),
+        jnp.zeros((n,), dtype=jnp.int32),
+    )
+
+    def step(state, _):
+        state = _tick_body(dg, block, state, origins, slots, gen_ticks)
+        cov = bitmask.coverage_per_slot(state[1], chunk_size)
+        return state, cov
+
+    state, coverage = jax.lax.scan(step, state, None, length=horizon)
+    _, seen, _, received, sent = state
+    return seen, received, sent, coverage
+
+
+def _pad_chunk(chunk: Schedule, chunk_size: int, horizon: int):
+    """Pad a schedule chunk to the static chunk_size; padded slots get
+    gen_tick == horizon so they never fire."""
+    s = chunk.num_shares
+    origins = np.zeros(chunk_size, dtype=np.int32)
+    gen_ticks = np.full(chunk_size, horizon, dtype=np.int32)
+    origins[:s] = chunk.origins
+    gen_ticks[:s] = chunk.gen_ticks
+    return jnp.asarray(origins), jnp.asarray(gen_ticks)
+
+
+def run_sync_sim(
+    graph: Graph,
+    schedule: Schedule,
+    horizon_ticks: int,
+    ell_delays: np.ndarray | None = None,
+    constant_delay: int = 1,
+    chunk_size: int = DEFAULT_CHUNK_SIZE,
+    block: int = DEFAULT_DEGREE_BLOCK,
+    device_graph: DeviceGraph | None = None,
+) -> NodeStats:
+    """Run the full simulation on the synchronous engine.
+
+    Drop-in counterpart of `engine.event.run_event_sim`: same inputs,
+    identical per-node counters (the parity tests assert exactly this).
+    """
+    dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
+    chunk_size = min(chunk_size, max(32, schedule.num_shares))
+    # Round chunk size up to whole words.
+    chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
+
+    received = np.zeros(graph.n, dtype=np.int64)
+    sent = np.zeros(graph.n, dtype=np.int64)
+    for chunk in schedule.chunk(chunk_size) or [Schedule(graph.n, [], [])]:
+        live = chunk.gen_ticks < horizon_ticks
+        if not live.any():
+            continue
+        origins, gen_ticks = _pad_chunk(chunk, chunk_size, horizon_ticks)
+        t_start = jnp.asarray(int(chunk.gen_ticks[live].min()), dtype=jnp.int32)
+        last_gen = jnp.asarray(int(chunk.gen_ticks[live].max()), dtype=jnp.int32)
+        _, r, s = _run_chunk_while(
+            dg, origins, gen_ticks, t_start, last_gen,
+            chunk_size=chunk_size, horizon=horizon_ticks, block=block,
+        )
+        received += np.asarray(r, dtype=np.int64)
+        sent += np.asarray(s, dtype=np.int64)
+
+    generated = schedule.generated_per_node(horizon_ticks).astype(np.int64)
+    degree = np.asarray(dg.degree, dtype=np.int64)
+    # Generation itself also broadcasts (GossipShareToPeers, p2pnode.cc:123):
+    # already folded into `sent` on-device via gen_cnt.
+    return NodeStats(
+        generated=generated,
+        received=received,
+        forwarded=received.copy(),
+        sent=sent,
+        processed=generated + received,
+        degree=degree,
+    )
+
+
+def run_flood_coverage(
+    graph: Graph,
+    origins: np.ndarray | list[int],
+    horizon_ticks: int,
+    ell_delays: np.ndarray | None = None,
+    constant_delay: int = 1,
+    block: int = DEFAULT_DEGREE_BLOCK,
+    device_graph: DeviceGraph | None = None,
+):
+    """Flood coverage-time experiment: one share per origin, all at t=0.
+
+    Returns (stats, coverage) where coverage is (horizon, num_origins) node
+    counts per tick — the time-to-99%-share-coverage curve from
+    BASELINE.json's headline config.
+    """
+    origins = np.asarray(origins, dtype=np.int32).reshape(-1)
+    s = origins.shape[0]
+    chunk_size = bitmask.num_words(s) * bitmask.WORD_BITS
+    dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
+    sched = Schedule(graph.n, origins, np.zeros(s, dtype=np.int32))
+    o, g = _pad_chunk(sched, chunk_size, horizon_ticks)
+    _, r, snt, cov = _run_chunk_scan(
+        dg, o, g, chunk_size=chunk_size, horizon=horizon_ticks, block=block
+    )
+    generated = sched.generated_per_node(horizon_ticks).astype(np.int64)
+    received = np.asarray(r, dtype=np.int64)
+    stats = NodeStats(
+        generated=generated,
+        received=received,
+        forwarded=received.copy(),
+        sent=np.asarray(snt, dtype=np.int64),
+        processed=generated + received,
+        degree=np.asarray(dg.degree, dtype=np.int64),
+    )
+    coverage = np.asarray(cov)[:, :s]
+    stats.extra["coverage"] = coverage
+    return stats, coverage
+
+
+def time_to_coverage(coverage: np.ndarray, n: int, fraction: float = 0.99):
+    """First tick at which each share reaches ``fraction`` of nodes (-1 if
+    never). coverage: (T, S)."""
+    target = int(np.ceil(fraction * n))
+    hit = coverage >= target
+    first = np.where(hit.any(axis=0), hit.argmax(axis=0), -1)
+    return first

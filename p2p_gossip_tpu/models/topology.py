@@ -9,6 +9,15 @@ dense) form that the TPU tick engine can gather over.
 Connectivity guarantee parity (p2pnetwork.cc:81-84): any row ``i`` with no
 sampled edge to a higher-numbered node gets a forced edge to ``i-1``
 (``(0, 1)`` for row 0) — including row ``N-1``, which always triggers the rule.
+
+Deliberate deviation: when a forced edge duplicates a sampled edge, the
+reference keys them differently (``connections[{i-1,i}]`` vs
+``connections[{i,i-1}]``, p2pnetwork.cc:129 vs :83) and ends up building a
+parallel physical link whose REGISTER path appends a duplicate peer without
+dedup (p2pnode.cc:186), double-sending to that peer thereafter. We treat that
+as an artifact, not a capability: edges here are canonicalized and
+deduplicated, so `Peer count`/`Total sent` in that rare corner are the
+single-link values.
 """
 
 from __future__ import annotations
@@ -185,18 +194,22 @@ def barabasi_albert(n: int, m: int = 3, seed: int = 0, batch: int = 1024) -> Gra
     seed_nodes = np.arange(m + 1)
     edges = [np.stack([seed_nodes, np.roll(seed_nodes, -1)], axis=1)]
     # Endpoint pool: each edge contributes both endpoints -> degree-weighted.
-    pool = [edges[0].ravel()]
+    # Preallocated and filled incrementally so batches are O(batch*m), not
+    # O(total pool) re-copies.
+    pool = np.empty(2 * ((m + 1) + m * (n - m - 1)), dtype=np.int64)
+    fill = 2 * (m + 1)
+    pool[:fill] = edges[0].ravel()
     next_node = m + 1
     while next_node < n:
         b = min(batch, n - next_node)
         new_nodes = np.arange(next_node, next_node + b)
-        flat_pool = np.concatenate(pool)
-        targets = flat_pool[rng.integers(0, flat_pool.shape[0], size=(b, m))]
+        targets = pool[rng.integers(0, fill, size=(b, m))]
         batch_edges = np.stack(
             [np.repeat(new_nodes, m), targets.ravel()], axis=1
         )
         edges.append(batch_edges)
-        pool.append(batch_edges.ravel())
+        pool[fill : fill + 2 * b * m] = batch_edges.ravel()
+        fill += 2 * b * m
         next_node += b
     return Graph.from_edges(n, np.concatenate(edges, axis=0))
 

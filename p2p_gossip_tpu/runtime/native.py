@@ -1,0 +1,197 @@
+"""Bindings to the native C++ runtime (``native/gossip_native.cc``).
+
+The reference's performance core is C++ on the NS-3 event scheduler; ours is
+a dependency-free C++ discrete-event engine with the same app-layer semantics
+(binary-heap scheduler, flat seen-bitset dedup), compiled to
+``native/libgossip_native.so`` (``make -C native``) and bound via ctypes —
+no pybind11 required. If the library isn't built, every entry point falls
+back to the pure-Python event engine with identical results.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import warnings
+
+import numpy as np
+
+from p2p_gossip_tpu.models.generation import Schedule
+from p2p_gossip_tpu.models.topology import Graph
+from p2p_gossip_tpu.utils.stats import NodeStats
+
+_LIB_PATHS = [
+    os.path.join(os.path.dirname(__file__), "..", "..", "native", "libgossip_native.so"),
+    os.path.join(os.path.dirname(__file__), "libgossip_native.so"),
+]
+
+_lib = None
+_lib_checked = False
+
+
+def load_library():
+    """Load and memoize the native library; None if unavailable."""
+    global _lib, _lib_checked
+    if _lib_checked:
+        return _lib
+    _lib_checked = True
+    for path in _LIB_PATHS:
+        path = os.path.abspath(path)
+        if os.path.exists(path):
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError as e:  # built for wrong arch etc.
+                warnings.warn(f"failed to load {path}: {e}")
+                continue
+            _configure(lib)
+            _lib = lib
+            break
+    return _lib
+
+
+def _configure(lib) -> None:
+    i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+    lib.gossip_run_event_sim.restype = ctypes.c_longlong
+    lib.gossip_run_event_sim.argtypes = [
+        ctypes.c_int64,              # n
+        i64p,                        # indptr (n+1)
+        i32p,                        # indices (nnz)
+        i32p,                        # csr_delays (nnz)
+        ctypes.c_int64,              # num_shares
+        i32p,                        # origins
+        i32p,                        # gen_ticks
+        ctypes.c_int64,              # horizon
+        ctypes.c_int64,              # num_snapshots
+        i64p, i64p, i64p,            # snapshot_ticks, snap_generated, snap_processed
+        i64p, i64p, i64p,            # out: generated, received, sent
+    ]
+    lib.gossip_build_er.restype = ctypes.c_longlong
+    lib.gossip_build_er.argtypes = [
+        ctypes.c_int64, ctypes.c_double, ctypes.c_uint64,
+        i64p,                        # out indptr (n+1)
+        i32p,                        # out indices (cap)
+        ctypes.c_int64,              # cap
+    ]
+    lib.gossip_build_ba.restype = ctypes.c_longlong
+    lib.gossip_build_ba.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
+        i64p, i32p, ctypes.c_int64,
+    ]
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+def run_native_sim(
+    graph: Graph,
+    schedule: Schedule,
+    horizon_ticks: int,
+    ell_delays: np.ndarray | None = None,
+    constant_delay: int = 1,
+    snapshot_ticks: list[int] | None = None,
+) -> NodeStats:
+    """Event-driven simulation on the C++ engine (counters identical to
+    `engine.event.run_event_sim`). Falls back to Python when unbuilt."""
+    lib = load_library()
+    if lib is None:
+        warnings.warn(
+            "native library not built (make -C native); using Python event engine"
+        )
+        from p2p_gossip_tpu.engine.event import run_event_sim
+
+        return run_event_sim(
+            graph, schedule, horizon_ticks, ell_delays, constant_delay,
+            snapshot_ticks=snapshot_ticks,
+        )
+
+    n = graph.n
+    if ell_delays is not None:
+        rows, pos = graph.csr_rows_pos()
+        csr_delays = np.ascontiguousarray(ell_delays[rows, pos], dtype=np.int32)
+    else:
+        csr_delays = np.full(graph.indices.shape[0], constant_delay, dtype=np.int32)
+
+    generated = np.zeros(n, dtype=np.int64)
+    received = np.zeros(n, dtype=np.int64)
+    sent = np.zeros(n, dtype=np.int64)
+    origins = np.ascontiguousarray(schedule.origins, dtype=np.int32)
+    gen_ticks = np.ascontiguousarray(schedule.gen_ticks, dtype=np.int32)
+    boundaries = np.asarray(sorted(snapshot_ticks or []), dtype=np.int64)
+    snap_gen = np.zeros(max(len(boundaries), 1), dtype=np.int64)
+    snap_proc = np.zeros(max(len(boundaries), 1), dtype=np.int64)
+    events = lib.gossip_run_event_sim(
+        n,
+        np.ascontiguousarray(graph.indptr, dtype=np.int64),
+        np.ascontiguousarray(graph.indices, dtype=np.int32),
+        csr_delays,
+        schedule.num_shares,
+        origins,
+        gen_ticks,
+        horizon_ticks,
+        len(boundaries),
+        np.ascontiguousarray(boundaries) if len(boundaries) else snap_gen,
+        snap_gen,
+        snap_proc,
+        generated,
+        received,
+        sent,
+    )
+    stats = NodeStats(
+        generated=generated,
+        received=received,
+        forwarded=received.copy(),
+        sent=sent,
+        processed=generated + received,
+        degree=graph.degree.astype(np.int64),
+    )
+    stats.extra["events_processed"] = int(events)
+    if len(boundaries):
+        connections = int(graph.degree.sum())
+        stats.extra["snapshots"] = [
+            {
+                "tick": int(boundaries[i]),
+                "generated": int(snap_gen[i]),
+                "processed": int(snap_proc[i]),
+                "connections": connections,
+            }
+            for i in range(len(boundaries))
+        ]
+    return stats
+
+
+def _build_native_graph(
+    fn_name: str, n: int, arg, seed: int, cap: int | None = None
+) -> Graph | None:
+    lib = load_library()
+    if lib is None:
+        return None
+    # Capacity guess; the builder returns required nnz (or -needed if short).
+    if cap is None:
+        if fn_name == "gossip_build_er":
+            cap = max(1024, int(2.5 * n * max(n - 1, 1) * arg / 2) + 4 * n)
+        else:
+            cap = max(1024, 4 * n * int(arg) + 64)
+    fn = getattr(lib, fn_name)
+    for _ in range(3):
+        indptr = np.zeros(n + 1, dtype=np.int64)
+        indices = np.zeros(cap, dtype=np.int32)
+        if fn_name == "gossip_build_er":
+            nnz = fn(n, float(arg), seed, indptr, indices, cap)
+        else:
+            nnz = fn(n, int(arg), seed, indptr, indices, cap)
+        if nnz >= 0:
+            return Graph(n=n, indptr=indptr, indices=indices[:nnz].copy())
+        cap = -int(nnz) + 64
+    raise RuntimeError("native graph builder failed to allocate")
+
+
+def native_erdos_renyi(n: int, p: float, seed: int = 0) -> Graph | None:
+    """C++ ER builder (same forced-edge connectivity rule); None if unbuilt."""
+    return _build_native_graph("gossip_build_er", n, p, seed)
+
+
+def native_barabasi_albert(n: int, m: int = 3, seed: int = 0) -> Graph | None:
+    """C++ exact BA preferential-attachment builder; None if unbuilt."""
+    return _build_native_graph("gossip_build_ba", n, m, seed)

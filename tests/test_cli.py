@@ -1,0 +1,65 @@
+"""CLI smoke tests — reference flags, reference output format."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(*args):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("PYTHONPATH", REPO)
+    return subprocess.run(
+        [sys.executable, "-m", "p2p_gossip_tpu", *args],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300,
+    )
+
+
+def test_reference_default_flags_event_backend():
+    r = _run_cli(
+        "--numNodes", "10", "--connectionProb", "0.3", "--simTime", "20",
+        "--Latency", "5", "--backend", "event", "--seed", "1",
+    )
+    assert r.returncode == 0, r.stderr
+    assert "=== P2P Gossip Network Simulation Statistics ===" in r.stdout
+    assert "Node 0: Generated" in r.stdout
+    assert "Total shares generated:" in r.stdout
+    assert "=== Periodic Stats at 10s ===" in r.stdout
+
+
+def test_tpu_backend_matches_event_backend_totals():
+    common = [
+        "--numNodes", "30", "--connectionProb", "0.1", "--simTime", "10",
+        "--Latency", "10", "--seed", "3",
+    ]
+    ev = _run_cli(*common, "--backend", "event")
+    tp = _run_cli(*common, "--backend", "tpu")
+    assert ev.returncode == 0 and tp.returncode == 0, ev.stderr + tp.stderr
+
+    def node_lines(out):
+        return sorted(l for l in out.splitlines() if l.startswith("Node "))
+
+    assert node_lines(ev.stdout) == node_lines(tp.stdout)
+
+
+def test_anim_export(tmp_path):
+    out = tmp_path / "anim.xml"
+    r = _run_cli(
+        "--numNodes", "9", "--simTime", "5", "--backend", "event",
+        "--anim", str(out),
+    )
+    assert r.returncode == 0, r.stderr
+    text = out.read_text()
+    assert text.startswith('<?xml version="1.0"')
+    assert '<node id="8"' in text
+    assert "<link fromId=" in text
+
+
+def test_bad_flag_fails_cleanly():
+    r = _run_cli("--backend", "gpu")
+    assert r.returncode != 0
+    assert "invalid choice" in r.stderr

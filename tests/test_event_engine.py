@@ -87,6 +87,24 @@ def test_heterogeneous_delays_slow_the_flood():
     assert a_slow.sum() > a_fast.sum()
 
 
+def test_snapshots_match_truncated_horizon_runs():
+    # A snapshot at tick T must equal the totals of a fresh run with
+    # horizon=T (PrintPeriodicStats semantics).
+    g = erdos_renyi(30, 0.1, seed=9)
+    sched = uniform_renewal_schedule(30, sim_time=30.0, tick_dt=0.01, seed=9)
+    boundaries = [500, 1500, 2500]
+    full = run_event_sim(g, sched, 3000, snapshot_ticks=boundaries)
+    snaps = full.extra["snapshots"]
+    assert [s["tick"] for s in snaps] == boundaries
+    for snap in snaps:
+        trunc = run_event_sim(g, sched, snap["tick"])
+        t = trunc.totals()
+        assert snap["generated"] == t["generated"]
+        assert snap["processed"] == t["processed"]
+    # Monotone progress.
+    assert snaps[0]["processed"] < snaps[1]["processed"] < snaps[2]["processed"]
+
+
 def test_final_statistics_format():
     g = ring_graph(3)
     stats = run_event_sim(g, single_share_schedule(3), horizon_ticks=10)

@@ -1,0 +1,97 @@
+"""Sync (TPU tick) engine tests — exact parity with the event-driven oracle.
+
+This is the "NS-3 stats parity" axis: same topology + schedule + integer
+delays must give identical per-node counters on both engines.
+"""
+
+import numpy as np
+import pytest
+
+import p2p_gossip_tpu as pg
+from p2p_gossip_tpu.engine.event import run_event_sim
+from p2p_gossip_tpu.engine.sync import (
+    run_flood_coverage,
+    run_sync_sim,
+    time_to_coverage,
+)
+from p2p_gossip_tpu.models.generation import single_share_schedule
+from p2p_gossip_tpu.models.latency import lognormal_delays
+from p2p_gossip_tpu.models.topology import barabasi_albert, ring_graph
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_parity_er_constant_delay(seed):
+    g = pg.erdos_renyi(100, 0.05, seed=seed)
+    sched = pg.uniform_renewal_schedule(100, sim_time=20.0, tick_dt=0.005, seed=seed)
+    horizon = int(20.0 / 0.005)
+    ev = run_event_sim(g, sched, horizon)
+    sy = run_sync_sim(g, sched, horizon)
+    assert sy.equal_counts(ev)
+    sy.check_conservation()
+
+
+def test_parity_heterogeneous_delays():
+    g = pg.erdos_renyi(80, 0.06, seed=3)
+    d = lognormal_delays(g, mean_ticks=2.0, sigma=0.6, max_ticks=5, seed=1)
+    sched = pg.uniform_renewal_schedule(80, sim_time=3.0, tick_dt=0.005, seed=3)
+    ev = run_event_sim(g, sched, 700, ell_delays=d)
+    sy = run_sync_sim(g, sched, 700, ell_delays=d)
+    assert sy.equal_counts(ev)
+
+
+def test_parity_truncated_horizon():
+    # Horizon cuts floods mid-flight: both engines must cut identically.
+    g = ring_graph(40)
+    sched = pg.uniform_renewal_schedule(40, sim_time=2.0, tick_dt=0.1, seed=4)
+    for horizon in (3, 7, 15):
+        ev = run_event_sim(g, sched, horizon)
+        sy = run_sync_sim(g, sched, horizon)
+        assert sy.equal_counts(ev), f"horizon={horizon}"
+
+
+def test_parity_scale_free_topology():
+    g = barabasi_albert(200, m=2, seed=5)
+    sched = pg.poisson_schedule(200, sim_time=5.0, tick_dt=0.01, rate=0.1, seed=5)
+    horizon = 600
+    ev = run_event_sim(g, sched, horizon)
+    sy = run_sync_sim(g, sched, horizon)
+    assert sy.equal_counts(ev)
+
+
+def test_parity_multiple_chunks():
+    # Chunked execution (shares split across several device passes) must be
+    # invisible in the counters.
+    g = pg.erdos_renyi(60, 0.08, seed=6)
+    sched = pg.uniform_renewal_schedule(60, sim_time=40.0, tick_dt=0.01, seed=6)
+    assert sched.num_shares > 128
+    ev = run_event_sim(g, sched, 4000)
+    sy = run_sync_sim(g, sched, 4000, chunk_size=128)
+    assert sy.equal_counts(ev)
+
+
+def test_flood_coverage_monotone_and_complete():
+    g = pg.erdos_renyi(128, 0.05, seed=7)
+    stats, cov = run_flood_coverage(g, [0, 17, 63], 64)
+    assert cov.shape[1] == 3
+    assert (np.diff(cov, axis=0) >= 0).all()
+    assert (cov[-1] == g.n).all()
+    t99 = time_to_coverage(cov, g.n, 0.99)
+    assert (t99 > 0).all()
+    stats.check_conservation()
+
+
+def test_flood_coverage_matches_event_arrivals():
+    g = ring_graph(16)
+    stats, cov = run_flood_coverage(g, [0], 20)
+    ev = run_event_sim(g, single_share_schedule(16), 20, coverage_slots=1)
+    arr = ev.extra["arrival_ticks"][0]
+    # Coverage at tick t == nodes with arrival tick <= t.
+    for t in range(20):
+        assert cov[t, 0] == int((arr >= 0).sum() if t >= arr.max() else (arr <= t).sum())
+
+
+def test_empty_schedule():
+    g = ring_graph(8)
+    sched = pg.Schedule(8, np.array([], dtype=np.int32), np.array([], dtype=np.int32))
+    sy = run_sync_sim(g, sched, 10)
+    assert sy.totals()["processed"] == 0
