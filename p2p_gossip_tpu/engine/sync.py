@@ -87,6 +87,22 @@ jax.tree_util.register_pytree_node(
 )
 
 
+def apply_tick_updates(seen, arrivals, gen_bits, gen_cnt, received, sent, degree):
+    """The shared counter semantics of one tick (reference: p2pnode.cc
+    ReceiveShare/GenerateAndGossipShare): dedup against ``seen``, count
+    first-time receives, and charge one send per peer per processed share.
+    Returns (seen, newly_out, received, sent) where ``newly_out`` is the
+    frontier this node contributes for the next delay-line slot. Used by
+    both the single-device and the sharded engines — the bitwise-parity
+    contract between them lives here."""
+    newly = arrivals & ~seen
+    newly_cnt = bitmask.popcount_rows(newly)
+    seen = seen | arrivals | gen_bits
+    received = received + newly_cnt
+    sent = sent + (newly_cnt + gen_cnt) * degree
+    return seen, newly | gen_bits, received, sent
+
+
 def _tick_body(dg: DeviceGraph, block: int, state, origins, slots, gen_ticks):
     """One synchronous tick. state = (t, seen, hist, received, sent)."""
     t, seen, hist, received, sent = state
@@ -102,12 +118,10 @@ def _tick_body(dg: DeviceGraph, block: int, state, origins, slots, gen_ticks):
         .at[origins]
         .add(gen_active.astype(jnp.int32))
     )
-    newly = arrivals & ~seen
-    newly_cnt = bitmask.popcount_rows(newly)
-    seen = seen | arrivals | gen_bits
-    received = received + newly_cnt
-    sent = sent + (newly_cnt + gen_cnt) * dg.degree
-    hist = hist.at[jnp.mod(t, dg.ring_size)].set(newly | gen_bits)
+    seen, newly_out, received, sent = apply_tick_updates(
+        seen, arrivals, gen_bits, gen_cnt, received, sent, dg.degree
+    )
+    hist = hist.at[jnp.mod(t, dg.ring_size)].set(newly_out)
     return (t + 1, seen, hist, received, sent)
 
 

@@ -46,9 +46,12 @@ def slot_scatter(
     """
     word = (slots // WORD_BITS).astype(jnp.int32)
     bit = (slots % WORD_BITS).astype(jnp.uint32)
-    vals = jnp.where(active, jnp.uint32(1) << bit, jnp.uint32(0))
+    in_range = (rows >= 0) & (rows < n_nodes)
+    vals = jnp.where(active & in_range, jnp.uint32(1) << bit, jnp.uint32(0))
     out = jnp.zeros((n_nodes, n_words), dtype=jnp.uint32)
-    return out.at[rows, word].add(vals)
+    # mode="drop": rows outside the local shard (sharded engine passes
+    # global-id minus row-offset) are discarded, never wrapped.
+    return out.at[rows, word].add(vals, mode="drop")
 
 
 def coverage_per_slot(seen: jnp.ndarray, n_slots: int) -> jnp.ndarray:

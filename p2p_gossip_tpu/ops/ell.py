@@ -46,40 +46,47 @@ def propagate(
     ring_size: int,
     block: int = DEFAULT_DEGREE_BLOCK,
 ) -> jnp.ndarray:
-    """Returns arrivals: (N, W) uint32 — shares arriving at each node at t."""
-    d, n, w = hist.shape
+    """Returns arrivals: (N_out, W) uint32 — shares arriving per tick.
+
+    ``hist`` spans all N_src source rows; the ELL arrays span the N_out
+    destination rows being computed. Single-device: N_out == N_src. Sharded
+    engine: N_out is the local row shard while hist holds the all_gathered
+    global frontier history (neighbor ids stay global).
+    """
+    d, n_src, w = hist.shape
+    n_out = ell_idx.shape[0]
     assert d == ring_size
-    flat = hist.reshape(d * n, w)
+    flat = hist.reshape(d * n_src, w)
 
     idx = _pad_degree_axis(ell_idx, block, 0)
     dly = _pad_degree_axis(ell_delay, block, 1)
     msk = _pad_degree_axis(ell_mask, block, False)
     nblocks = idx.shape[1] // block
-    # (nblocks, N, B) so scan slices are contiguous.
-    idx = idx.reshape(n, nblocks, block).transpose(1, 0, 2)
-    dly = dly.reshape(n, nblocks, block).transpose(1, 0, 2)
-    msk = msk.reshape(n, nblocks, block).transpose(1, 0, 2)
+    # (nblocks, N_out, B) so scan slices are contiguous.
+    idx = idx.reshape(n_out, nblocks, block).transpose(1, 0, 2)
+    dly = dly.reshape(n_out, nblocks, block).transpose(1, 0, 2)
+    msk = msk.reshape(n_out, nblocks, block).transpose(1, 0, 2)
 
     def body(acc, blk):
         b_idx, b_dly, b_msk = blk
         slot = jnp.mod(tick - b_dly, ring_size)
-        gathered = flat[slot * n + b_idx]  # (N, B, W)
+        gathered = flat[slot * n_src + b_idx]  # (N_out, B, W)
         gathered = jnp.where(b_msk[..., None], gathered, jnp.uint32(0))
         acc = acc | lax.reduce(
             gathered, jnp.uint32(0), lax.bitwise_or, (1,)
         )
         return acc, None
 
-    init = jnp.zeros((n, w), dtype=jnp.uint32)
+    init = jnp.zeros((n_out, w), dtype=jnp.uint32)
     arrivals, _ = lax.scan(body, init, (idx, dly, msk))
     return arrivals
 
 
 def propagate_reference(hist, tick, ell_idx, ell_delay, ell_mask, *, ring_size):
-    """Straight-line jnp version (materializes (N, dmax, W)) — oracle for
+    """Straight-line jnp version (materializes (N_out, dmax, W)) — oracle for
     tests and for the Pallas kernel."""
-    d, n, w = hist.shape
+    d, n_src, w = hist.shape
     slot = jnp.mod(tick - ell_delay, ring_size)
-    gathered = hist.reshape(d * n, w)[slot * n + ell_idx]
+    gathered = hist.reshape(d * n_src, w)[slot * n_src + ell_idx]
     gathered = jnp.where(ell_mask[..., None], gathered, jnp.uint32(0))
     return lax.reduce(gathered, jnp.uint32(0), lax.bitwise_or, (1,))

@@ -1,0 +1,204 @@
+"""Multi-chip synchronous engine: shard_map over a (shares, nodes) mesh.
+
+Scales the tick engine (engine/sync.py) the way the BASELINE.json headline
+config demands (1M nodes over a v5e-8 mesh): graph rows, seen-bitmask, and
+counters are sharded along ``nodes``; independent share chunks along
+``shares``. Per tick each node shard computes arrivals for its rows by
+gathering from the *global* newly-frontier history, then contributes its own
+newly-frontier via `lax.all_gather` over the nodes axis — the one collective
+on the hot path, sized (N x W_slice) words, riding ICI. Counters `psum` over
+the shares axis once per pass.
+
+Single-device equivalence is bitwise: the sharded engine runs the same tick
+body (`ops.ell.propagate` + bitmask updates) on row shards, and the tests
+assert identical per-node counters against `engine.sync` and `engine.event`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from p2p_gossip_tpu.engine.sync import apply_tick_updates
+from p2p_gossip_tpu.models.generation import Schedule
+from p2p_gossip_tpu.models.topology import Graph
+from p2p_gossip_tpu.ops import bitmask
+from p2p_gossip_tpu.ops.ell import DEFAULT_DEGREE_BLOCK, propagate
+from p2p_gossip_tpu.parallel.mesh import NODES_AXIS, SHARES_AXIS, pad_to_multiple
+from p2p_gossip_tpu.utils.stats import NodeStats
+
+
+def _padded_device_graph(
+    graph: Graph,
+    ell_delays: np.ndarray | None,
+    constant_delay: int,
+    n_node_shards: int,
+):
+    """ELL arrays padded so rows divide evenly across node shards. Padding
+    rows have empty masks: they never receive or send."""
+    ell_idx, ell_mask = graph.ell()
+    if ell_delays is None:
+        ell_delays = np.full(ell_idx.shape, constant_delay, dtype=np.int32)
+    ell_idx = pad_to_multiple(ell_idx, n_node_shards)
+    ell_mask = pad_to_multiple(ell_mask, n_node_shards)
+    ell_delays = pad_to_multiple(ell_delays, n_node_shards, fill=1)
+    degree = pad_to_multiple(graph.degree.astype(np.int32), n_node_shards)
+    ring = int(ell_delays.max()) + 1 if ell_delays.size else 2
+    return ell_idx, ell_delays, ell_mask, degree, ring
+
+
+@functools.lru_cache(maxsize=32)
+def build_sharded_runner(
+    mesh: Mesh,
+    n_padded: int,
+    ring_size: int,
+    chunk_size: int,
+    horizon: int,
+    block: int = DEFAULT_DEGREE_BLOCK,
+):
+    """Compile the per-pass runner: each shares-shard processes its own
+    ``chunk_size`` shares over the row-sharded graph, from the chunk's first
+    generation tick to quiescence. Memoized so repeated calls with the same
+    mesh/shapes reuse the jitted executable."""
+    n_share_shards = mesh.shape[SHARES_AXIS]
+    n_node_shards = mesh.shape[NODES_AXIS]
+    n_loc = n_padded // n_node_shards
+    w = bitmask.num_words(chunk_size)
+
+    def pass_fn(
+        ell_idx, ell_delay, ell_mask, degree, origins, gen_ticks,
+        t_start, last_gen,
+    ):
+        # Local shapes: ell_* (n_loc, dmax); origins/gen_ticks (chunk_size,);
+        # t_start/last_gen scalars (min/max over ALL slices, so loop trip
+        # counts agree across devices).
+        row_offset = lax.axis_index(NODES_AXIS).astype(jnp.int32) * n_loc
+        slots = jnp.arange(chunk_size, dtype=jnp.int32)
+
+        state = (
+            t_start,
+            jnp.zeros((n_loc, w), dtype=jnp.uint32),              # seen (local)
+            jnp.zeros((ring_size, n_padded, w), dtype=jnp.uint32),  # hist (global rows)
+            jnp.zeros((n_loc,), dtype=jnp.int32),                 # received
+            jnp.zeros((n_loc,), dtype=jnp.int32),                 # sent
+        )
+
+        def cond(state):
+            t, _, hist, _, _ = state
+            in_flight = jnp.any(hist != 0)
+            # Uniform predicate across every device: OR-reduce over the mesh.
+            in_flight = lax.psum(
+                in_flight.astype(jnp.int32), (SHARES_AXIS, NODES_AXIS)
+            ) > 0
+            return (t < horizon) & (in_flight | (t <= last_gen))
+
+        def body(state):
+            t, seen, hist, received, sent = state
+            arrivals = propagate(
+                hist, t, ell_idx, ell_delay, ell_mask,
+                ring_size=ring_size, block=block,
+            )
+            local_rows = origins - row_offset
+            # Negative indices wrap under .at[] before mode="drop" applies,
+            # so shares owned by other row shards must be masked explicitly.
+            gen_active = (
+                (gen_ticks == t) & (local_rows >= 0) & (local_rows < n_loc)
+            )
+            gen_bits = bitmask.slot_scatter(n_loc, w, local_rows, slots, gen_active)
+            gen_cnt = (
+                jnp.zeros((n_loc,), dtype=jnp.int32)
+                .at[local_rows]
+                .add(gen_active.astype(jnp.int32), mode="drop")
+            )
+            seen, newly_out, received, sent = apply_tick_updates(
+                seen, arrivals, gen_bits, gen_cnt, received, sent, degree
+            )
+            # The frontier exchange: local newly -> global rows, over ICI.
+            newly_full = lax.all_gather(newly_out, NODES_AXIS, axis=0, tiled=True)
+            hist = hist.at[jnp.mod(t, ring_size)].set(newly_full)
+            return (t + 1, seen, hist, received, sent)
+
+        _, seen, _, received, sent = lax.while_loop(cond, body, state)
+        # Fold the independent share slices: counters add across SHARES_AXIS.
+        received = lax.psum(received, SHARES_AXIS)
+        sent = lax.psum(sent, SHARES_AXIS)
+        return received, sent
+
+    mapped = shard_map(
+        pass_fn,
+        mesh=mesh,
+        in_specs=(
+            P(NODES_AXIS, None),  # ell_idx
+            P(NODES_AXIS, None),  # ell_delay
+            P(NODES_AXIS, None),  # ell_mask
+            P(NODES_AXIS),        # degree
+            P(SHARES_AXIS),       # origins
+            P(SHARES_AXIS),       # gen_ticks
+            P(),                  # t_start
+            P(),                  # last_gen
+        ),
+        out_specs=(P(NODES_AXIS), P(NODES_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(mapped), n_share_shards * chunk_size
+
+
+def run_sharded_sim(
+    graph: Graph,
+    schedule: Schedule,
+    horizon_ticks: int,
+    mesh: Mesh,
+    ell_delays: np.ndarray | None = None,
+    constant_delay: int = 1,
+    chunk_size: int = 256,
+    block: int = DEFAULT_DEGREE_BLOCK,
+) -> NodeStats:
+    """Drop-in counterpart of run_sync_sim/run_event_sim on a device mesh:
+    identical per-node counters, any number of shares."""
+    n_node_shards = mesh.shape[NODES_AXIS]
+    chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
+    ell_idx, ell_delay, ell_mask, degree, ring = _padded_device_graph(
+        graph, ell_delays, constant_delay, n_node_shards
+    )
+    n_padded = ell_idx.shape[0]
+    runner, pass_size = build_sharded_runner(
+        mesh, n_padded, ring, chunk_size, horizon_ticks, block
+    )
+
+    received = np.zeros(n_padded, dtype=np.int64)
+    sent = np.zeros(n_padded, dtype=np.int64)
+    for chunk in schedule.chunk(pass_size) or [Schedule(graph.n, [], [])]:
+        live = chunk.gen_ticks < horizon_ticks
+        if not live.any():
+            continue
+        origins = np.zeros(pass_size, dtype=np.int32)
+        gen_ticks = np.full(pass_size, horizon_ticks, dtype=np.int32)
+        origins[: chunk.num_shares] = chunk.origins
+        gen_ticks[: chunk.num_shares] = chunk.gen_ticks
+        t_start = np.int32(chunk.gen_ticks[live].min())
+        last_gen = np.int32(chunk.gen_ticks[live].max())
+        r, s = runner(
+            ell_idx, ell_delay, ell_mask, degree, origins, gen_ticks,
+            t_start, last_gen,
+        )
+        received += np.asarray(r, dtype=np.int64)
+        sent += np.asarray(s, dtype=np.int64)
+
+    received = received[: graph.n]
+    sent = sent[: graph.n]
+    generated = schedule.generated_per_node(horizon_ticks).astype(np.int64)
+    return NodeStats(
+        generated=generated,
+        received=received,
+        forwarded=received.copy(),
+        sent=sent,
+        processed=generated + received,
+        degree=graph.degree.astype(np.int64),
+    )

@@ -1,0 +1,52 @@
+"""Device-mesh helpers.
+
+The scale axis of the reference is one CPU core; ours is a
+``jax.sharding.Mesh`` over TPU chips. Two logical axes:
+
+- ``nodes``  — partitions graph rows (adjacency, seen-bitmask, counters);
+  the per-tick frontier exchange `all_gather`s newly-frontiers along it,
+  riding ICI.
+- ``shares`` — partitions share chunks (independent work, embarrassingly
+  parallel); counters `psum` along it at the end.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+NODES_AXIS = "nodes"
+SHARES_AXIS = "shares"
+
+
+def make_mesh(
+    n_node_shards: int | None = None,
+    n_share_shards: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a (shares, nodes) mesh. Defaults to all devices on the nodes
+    axis (frontier exchange prefers the faster/denser axis)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_node_shards is None:
+        n_node_shards = len(devices) // n_share_shards
+    want = n_node_shards * n_share_shards
+    if want > len(devices):
+        raise ValueError(
+            f"mesh {n_share_shards}x{n_node_shards} needs {want} devices, "
+            f"have {len(devices)}"
+        )
+    dev_array = np.array(devices[:want]).reshape(n_share_shards, n_node_shards)
+    return Mesh(dev_array, (SHARES_AXIS, NODES_AXIS))
+
+
+def pad_to_multiple(x: np.ndarray, multiple: int, axis: int = 0, fill=0):
+    """Pad an array so its ``axis`` length divides evenly across shards."""
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return np.pad(x, widths, constant_values=fill)

@@ -13,3 +13,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# On this box an experimental TPU plugin ("axon") registers regardless of
+# JAX_PLATFORMS, so pin the default device to CPU explicitly; sharding tests
+# grab the 8 virtual devices via jax.devices("cpu").
+import jax  # noqa: E402
+
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
