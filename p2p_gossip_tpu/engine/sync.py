@@ -35,7 +35,11 @@ import numpy as np
 from p2p_gossip_tpu.models.generation import Schedule
 from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
-from p2p_gossip_tpu.ops.ell import DEFAULT_DEGREE_BLOCK, propagate
+from p2p_gossip_tpu.ops.ell import (
+    DEFAULT_DEGREE_BLOCK,
+    propagate,
+    propagate_uniform,
+)
 from p2p_gossip_tpu.utils.stats import NodeStats
 
 DEFAULT_CHUNK_SIZE = 512
@@ -51,6 +55,7 @@ class DeviceGraph:
     ell_mask: jnp.ndarray   # (N, dmax) bool
     degree: jnp.ndarray     # (N,) int32
     ring_size: int          # D = max delay + 1
+    uniform_delay: int | None = None  # set when every edge has this delay
 
     @staticmethod
     def build(
@@ -62,6 +67,12 @@ class DeviceGraph:
         if ell_delays is None:
             ell_delays = np.full(ell_idx.shape, constant_delay, dtype=np.int32)
         dmax_delay = int(ell_delays.max()) if ell_delays.size else 1
+        valid = ell_delays[ell_mask] if ell_mask.size else ell_delays
+        uniform = (
+            int(valid.flat[0])
+            if valid.size and (valid == valid.flat[0]).all()
+            else None
+        )
         return DeviceGraph(
             n=graph.n,
             ell_idx=jnp.asarray(ell_idx, dtype=jnp.int32),
@@ -69,20 +80,22 @@ class DeviceGraph:
             ell_mask=jnp.asarray(ell_mask),
             degree=jnp.asarray(graph.degree, dtype=jnp.int32),
             ring_size=dmax_delay + 1,
+            uniform_delay=uniform,
         )
 
 
-# Pytree registration: arrays are leaves, (n, ring_size) ride along as static
-# aux data — so a DeviceGraph passes straight through jit/shard_map.
+# Pytree registration: arrays are leaves; (n, ring_size, uniform_delay) ride
+# along as static aux data — so a DeviceGraph passes straight through
+# jit/shard_map and path selection on uniform_delay stays trace-time.
 jax.tree_util.register_pytree_node(
     DeviceGraph,
     lambda dg: (
         (dg.ell_idx, dg.ell_delay, dg.ell_mask, dg.degree),
-        (dg.n, dg.ring_size),
+        (dg.n, dg.ring_size, dg.uniform_delay),
     ),
     lambda aux, ch: DeviceGraph(
         n=aux[0], ell_idx=ch[0], ell_delay=ch[1], ell_mask=ch[2],
-        degree=ch[3], ring_size=aux[1],
+        degree=ch[3], ring_size=aux[1], uniform_delay=aux[2],
     ),
 )
 
@@ -107,10 +120,16 @@ def _tick_body(dg: DeviceGraph, block: int, state, origins, slots, gen_ticks):
     """One synchronous tick. state = (t, seen, hist, received, sent)."""
     t, seen, hist, received, sent = state
     n, w = seen.shape
-    arrivals = propagate(
-        hist, t, dg.ell_idx, dg.ell_delay, dg.ell_mask,
-        ring_size=dg.ring_size, block=block,
-    )
+    if dg.uniform_delay is not None:
+        arrivals = propagate_uniform(
+            hist, t, dg.ell_idx, dg.ell_mask,
+            ring_size=dg.ring_size, uniform_delay=dg.uniform_delay, block=block,
+        )
+    else:
+        arrivals = propagate(
+            hist, t, dg.ell_idx, dg.ell_delay, dg.ell_mask,
+            ring_size=dg.ring_size, block=block,
+        )
     gen_active = gen_ticks == t
     gen_bits = bitmask.slot_scatter(n, w, origins, slots, gen_active)
     gen_cnt = (
@@ -197,17 +216,6 @@ def _run_chunk_scan(
     return seen, received, sent, coverage
 
 
-def _pad_chunk(chunk: Schedule, chunk_size: int, horizon: int):
-    """Pad a schedule chunk to the static chunk_size; padded slots get
-    gen_tick == horizon so they never fire."""
-    s = chunk.num_shares
-    origins = np.zeros(chunk_size, dtype=np.int32)
-    gen_ticks = np.full(chunk_size, horizon, dtype=np.int32)
-    origins[:s] = chunk.origins
-    gen_ticks[:s] = chunk.gen_ticks
-    return jnp.asarray(origins), jnp.asarray(gen_ticks)
-
-
 def run_sync_sim(
     graph: Graph,
     schedule: Schedule,
@@ -230,15 +238,15 @@ def run_sync_sim(
 
     received = np.zeros(graph.n, dtype=np.int64)
     sent = np.zeros(graph.n, dtype=np.int64)
-    for chunk in schedule.chunk(chunk_size) or [Schedule(graph.n, [], [])]:
+    for chunk in schedule.chunk(chunk_size):
         live = chunk.gen_ticks < horizon_ticks
         if not live.any():
             continue
-        origins, gen_ticks = _pad_chunk(chunk, chunk_size, horizon_ticks)
+        origins, gen_ticks = chunk.padded(chunk_size, horizon_ticks)
         t_start = jnp.asarray(int(chunk.gen_ticks[live].min()), dtype=jnp.int32)
         last_gen = jnp.asarray(int(chunk.gen_ticks[live].max()), dtype=jnp.int32)
         _, r, s = _run_chunk_while(
-            dg, origins, gen_ticks, t_start, last_gen,
+            dg, jnp.asarray(origins), jnp.asarray(gen_ticks), t_start, last_gen,
             chunk_size=chunk_size, horizon=horizon_ticks, block=block,
         )
         received += np.asarray(r, dtype=np.int64)
@@ -278,9 +286,10 @@ def run_flood_coverage(
     chunk_size = bitmask.num_words(s) * bitmask.WORD_BITS
     dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
     sched = Schedule(graph.n, origins, np.zeros(s, dtype=np.int32))
-    o, g = _pad_chunk(sched, chunk_size, horizon_ticks)
+    o, g = sched.padded(chunk_size, horizon_ticks)
     _, r, snt, cov = _run_chunk_scan(
-        dg, o, g, chunk_size=chunk_size, horizon=horizon_ticks, block=block
+        dg, jnp.asarray(o), jnp.asarray(g),
+        chunk_size=chunk_size, horizon=horizon_ticks, block=block,
     )
     generated = sched.generated_per_node(horizon_ticks).astype(np.int64)
     received = np.asarray(r, dtype=np.int64)

@@ -48,6 +48,17 @@ class Schedule:
             self.origins[mask], minlength=self.n_nodes
         ).astype(np.int32)
 
+    def padded(self, chunk_size: int, horizon: int) -> tuple[np.ndarray, np.ndarray]:
+        """(origins, gen_ticks) padded to ``chunk_size``; padded slots get
+        gen_tick == horizon, the never-fires sentinel. Shared by the
+        single-device and sharded engines so the padding convention cannot
+        diverge."""
+        origins = np.zeros(chunk_size, dtype=np.int32)
+        gen_ticks = np.full(chunk_size, horizon, dtype=np.int32)
+        origins[: self.num_shares] = self.origins
+        gen_ticks[: self.num_shares] = self.gen_ticks
+        return origins, gen_ticks
+
     def chunk(self, chunk_size: int) -> list["Schedule"]:
         """Split into fixed-size chunks (shares are independent; counters are
         additive across chunks — this is what gives the TPU engine static

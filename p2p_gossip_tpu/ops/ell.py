@@ -82,6 +82,47 @@ def propagate(
     return arrivals
 
 
+@functools.partial(
+    jax.jit, static_argnames=("ring_size", "block", "uniform_delay")
+)
+def propagate_uniform(
+    hist: jnp.ndarray,      # (D, N_src, W) uint32
+    tick: jnp.ndarray,      # scalar int32
+    ell_idx: jnp.ndarray,   # (N_out, dmax) int32
+    ell_mask: jnp.ndarray,  # (N_out, dmax) bool
+    *,
+    ring_size: int,
+    uniform_delay: int = 1,
+    block: int = DEFAULT_DEGREE_BLOCK,
+) -> jnp.ndarray:
+    """Fast path for a uniform per-edge delay (the reference's constant-link
+    -latency model): the delay-line slot is one scalar per tick, so the
+    per-edge delay gather — and the whole (N, dmax) delay array read from
+    HBM — disappears."""
+    d, n_src, w = hist.shape
+    n_out = ell_idx.shape[0]
+    assert d == ring_size
+    # One source frontier for the whole tick.
+    src = hist[jnp.mod(tick - uniform_delay, ring_size)]  # (N_src, W)
+
+    idx = _pad_degree_axis(ell_idx, block, 0)
+    msk = _pad_degree_axis(ell_mask, block, False)
+    nblocks = idx.shape[1] // block
+    idx = idx.reshape(n_out, nblocks, block).transpose(1, 0, 2)
+    msk = msk.reshape(n_out, nblocks, block).transpose(1, 0, 2)
+
+    def body(acc, blk):
+        b_idx, b_msk = blk
+        gathered = src[b_idx]  # (N_out, B, W)
+        gathered = jnp.where(b_msk[..., None], gathered, jnp.uint32(0))
+        acc = acc | lax.reduce(gathered, jnp.uint32(0), lax.bitwise_or, (1,))
+        return acc, None
+
+    init = jnp.zeros((n_out, w), dtype=jnp.uint32)
+    arrivals, _ = lax.scan(body, init, (idx, msk))
+    return arrivals
+
+
 def propagate_reference(hist, tick, ell_idx, ell_delay, ell_mask, *, ring_size):
     """Straight-line jnp version (materializes (N_out, dmax, W)) — oracle for
     tests and for the Pallas kernel."""

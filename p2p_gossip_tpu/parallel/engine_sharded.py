@@ -30,7 +30,11 @@ from p2p_gossip_tpu.engine.sync import apply_tick_updates
 from p2p_gossip_tpu.models.generation import Schedule
 from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
-from p2p_gossip_tpu.ops.ell import DEFAULT_DEGREE_BLOCK, propagate
+from p2p_gossip_tpu.ops.ell import (
+    DEFAULT_DEGREE_BLOCK,
+    propagate,
+    propagate_uniform,
+)
 from p2p_gossip_tpu.parallel.mesh import NODES_AXIS, SHARES_AXIS, pad_to_multiple
 from p2p_gossip_tpu.utils.stats import NodeStats
 
@@ -47,11 +51,17 @@ def _padded_device_graph(
     if ell_delays is None:
         ell_delays = np.full(ell_idx.shape, constant_delay, dtype=np.int32)
     ell_idx = pad_to_multiple(ell_idx, n_node_shards)
+    valid = ell_delays[ell_mask] if ell_mask.size else ell_delays
+    uniform = (
+        int(valid.flat[0])
+        if valid.size and (valid == valid.flat[0]).all()
+        else None
+    )
     ell_mask = pad_to_multiple(ell_mask, n_node_shards)
     ell_delays = pad_to_multiple(ell_delays, n_node_shards, fill=1)
     degree = pad_to_multiple(graph.degree.astype(np.int32), n_node_shards)
     ring = int(ell_delays.max()) + 1 if ell_delays.size else 2
-    return ell_idx, ell_delays, ell_mask, degree, ring
+    return ell_idx, ell_delays, ell_mask, degree, ring, uniform
 
 
 @functools.lru_cache(maxsize=32)
@@ -62,6 +72,7 @@ def build_sharded_runner(
     chunk_size: int,
     horizon: int,
     block: int = DEFAULT_DEGREE_BLOCK,
+    uniform_delay: int | None = None,
 ):
     """Compile the per-pass runner: each shares-shard processes its own
     ``chunk_size`` shares over the row-sharded graph, from the chunk's first
@@ -101,10 +112,17 @@ def build_sharded_runner(
 
         def body(state):
             t, seen, hist, received, sent = state
-            arrivals = propagate(
-                hist, t, ell_idx, ell_delay, ell_mask,
-                ring_size=ring_size, block=block,
-            )
+            if uniform_delay is not None:
+                arrivals = propagate_uniform(
+                    hist, t, ell_idx, ell_mask,
+                    ring_size=ring_size, uniform_delay=uniform_delay,
+                    block=block,
+                )
+            else:
+                arrivals = propagate(
+                    hist, t, ell_idx, ell_delay, ell_mask,
+                    ring_size=ring_size, block=block,
+                )
             local_rows = origins - row_offset
             # Negative indices wrap under .at[] before mode="drop" applies,
             # so shares owned by other row shards must be masked explicitly.
@@ -164,24 +182,21 @@ def run_sharded_sim(
     identical per-node counters, any number of shares."""
     n_node_shards = mesh.shape[NODES_AXIS]
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
-    ell_idx, ell_delay, ell_mask, degree, ring = _padded_device_graph(
+    ell_idx, ell_delay, ell_mask, degree, ring, uniform = _padded_device_graph(
         graph, ell_delays, constant_delay, n_node_shards
     )
     n_padded = ell_idx.shape[0]
     runner, pass_size = build_sharded_runner(
-        mesh, n_padded, ring, chunk_size, horizon_ticks, block
+        mesh, n_padded, ring, chunk_size, horizon_ticks, block, uniform
     )
 
     received = np.zeros(n_padded, dtype=np.int64)
     sent = np.zeros(n_padded, dtype=np.int64)
-    for chunk in schedule.chunk(pass_size) or [Schedule(graph.n, [], [])]:
+    for chunk in schedule.chunk(pass_size):
         live = chunk.gen_ticks < horizon_ticks
         if not live.any():
             continue
-        origins = np.zeros(pass_size, dtype=np.int32)
-        gen_ticks = np.full(pass_size, horizon_ticks, dtype=np.int32)
-        origins[: chunk.num_shares] = chunk.origins
-        gen_ticks[: chunk.num_shares] = chunk.gen_ticks
+        origins, gen_ticks = chunk.padded(pass_size, horizon_ticks)
         t_start = np.int32(chunk.gen_ticks[live].min())
         last_gen = np.int32(chunk.gen_ticks[live].max())
         r, s = runner(
