@@ -1,8 +1,8 @@
 """Headline benchmark — prints ONE JSON line on stdout.
 
 Workload (BASELINE.json config 3): 100K-node Erdős–Rényi p=0.001 (mean
-degree ~100), 2048 Poisson-ish shares generated over a 16-tick window,
-flooded to full coverage. Metric: node-updates/sec — one node-update is one
+degree ~100), 4096 shares with uniformly sampled origins and generation
+ticks over a 16-tick window, flooded to full coverage. Metric: node-updates/sec — one node-update is one
 node processing one new share (the reference's per-node `processed` counter,
 p2pnode.cc:241). The TPU synchronous tick engine is measured after one
 warmup pass (compile excluded, as for any steady-state simulation);

@@ -37,6 +37,7 @@ from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
 from p2p_gossip_tpu.ops.ell import (
     DEFAULT_DEGREE_BLOCK,
+    detect_uniform_delay,
     propagate,
     propagate_uniform,
 )
@@ -67,12 +68,11 @@ class DeviceGraph:
         if ell_delays is None:
             ell_delays = np.full(ell_idx.shape, constant_delay, dtype=np.int32)
         dmax_delay = int(ell_delays.max()) if ell_delays.size else 1
-        valid = ell_delays[ell_mask] if ell_mask.size else ell_delays
-        uniform = (
-            int(valid.flat[0])
-            if valid.size and (valid == valid.flat[0]).all()
-            else None
-        )
+        uniform = detect_uniform_delay(ell_delays, ell_mask)
+        if uniform is not None:
+            # The fast path never reads per-edge delays: stage a placeholder
+            # instead of an (N, dmax) array of dead HBM.
+            ell_delays = np.ones((1, 1), dtype=np.int32)
         return DeviceGraph(
             n=graph.n,
             ell_idx=jnp.asarray(ell_idx, dtype=jnp.int32),
@@ -183,7 +183,7 @@ def _run_chunk_while(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("chunk_size", "horizon", "block")
+    jax.jit, static_argnames=("chunk_size", "horizon", "block", "use_pallas")
 )
 def _run_chunk_scan(
     dg: DeviceGraph,
@@ -193,9 +193,11 @@ def _run_chunk_scan(
     chunk_size: int,
     horizon: int,
     block: int,
+    use_pallas: bool = False,
 ):
     """Fixed-horizon scan from t=0 recording per-tick coverage (S,) —
-    drives the time-to-coverage metrics."""
+    drives the time-to-coverage metrics. ``use_pallas`` selects the one-pass
+    coverage kernel (ops/pallas_kernels.py) on TPU."""
     n, w = dg.n, bitmask.num_words(chunk_size)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
     state = (
@@ -208,7 +210,12 @@ def _run_chunk_scan(
 
     def step(state, _):
         state = _tick_body(dg, block, state, origins, slots, gen_ticks)
-        cov = bitmask.coverage_per_slot(state[1], chunk_size)
+        if use_pallas:
+            from p2p_gossip_tpu.ops.pallas_kernels import coverage_per_slot_pallas
+
+            cov = coverage_per_slot_pallas(state[1], chunk_size)
+        else:
+            cov = bitmask.coverage_per_slot(state[1], chunk_size)
         return state, cov
 
     state, coverage = jax.lax.scan(step, state, None, length=horizon)
@@ -287,9 +294,13 @@ def run_flood_coverage(
     dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
     sched = Schedule(graph.n, origins, np.zeros(s, dtype=np.int32))
     o, g = sched.padded(chunk_size, horizon_ticks)
+    # Gate on where the graph actually lives (tests pin data to host CPU
+    # even though a TPU plugin is registered).
+    use_pallas = any(d.platform == "tpu" for d in dg.ell_idx.devices())
     _, r, snt, cov = _run_chunk_scan(
         dg, jnp.asarray(o), jnp.asarray(g),
         chunk_size=chunk_size, horizon=horizon_ticks, block=block,
+        use_pallas=use_pallas,
     )
     generated = sched.generated_per_node(horizon_ticks).astype(np.int64)
     received = np.asarray(r, dtype=np.int64)

@@ -27,6 +27,19 @@ from jax import lax
 DEFAULT_DEGREE_BLOCK = 8
 
 
+def detect_uniform_delay(ell_delays, ell_mask) -> int | None:
+    """The single source of truth for choosing the uniform-delay fast path:
+    returns the delay when every VALID edge shares it, else None."""
+    import numpy as np
+
+    ell_delays = np.asarray(ell_delays)
+    ell_mask = np.asarray(ell_mask)
+    valid = ell_delays[ell_mask] if ell_mask.size else ell_delays
+    if valid.size and (valid == valid.flat[0]).all():
+        return int(valid.flat[0])
+    return None
+
+
 def _pad_degree_axis(arr: jnp.ndarray, block: int, fill) -> jnp.ndarray:
     dmax = arr.shape[1]
     pad = (-dmax) % block

@@ -32,6 +32,7 @@ from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
 from p2p_gossip_tpu.ops.ell import (
     DEFAULT_DEGREE_BLOCK,
+    detect_uniform_delay,
     propagate,
     propagate_uniform,
 )
@@ -51,12 +52,7 @@ def _padded_device_graph(
     if ell_delays is None:
         ell_delays = np.full(ell_idx.shape, constant_delay, dtype=np.int32)
     ell_idx = pad_to_multiple(ell_idx, n_node_shards)
-    valid = ell_delays[ell_mask] if ell_mask.size else ell_delays
-    uniform = (
-        int(valid.flat[0])
-        if valid.size and (valid == valid.flat[0]).all()
-        else None
-    )
+    uniform = detect_uniform_delay(ell_delays, ell_mask)
     ell_mask = pad_to_multiple(ell_mask, n_node_shards)
     ell_delays = pad_to_multiple(ell_delays, n_node_shards, fill=1)
     degree = pad_to_multiple(graph.degree.astype(np.int32), n_node_shards)
