@@ -1,0 +1,228 @@
+"""Gossip protocol variants.
+
+The reference implements one protocol: eager push flooding (every new share
+is immediately re-broadcast to all peers, p2pnode.cc:155-165) — that is
+`engine.sync` / `engine.event`. This module adds the classic low-bandwidth
+alternative from BASELINE.json config 5: **push-pull anti-entropy** with
+optional per-edge latency delay lines.
+
+Each round, every node picks one uniform-random neighbor and exchanges
+digests both ways:
+
+- pull: node n ORs in its partner's seen-bitmask;
+- push: node n's bitmask is OR'd into its partner — a scatter-OR, built
+  TPU-style from sort + segmented OR-scan (`ops.segment.scatter_or`);
+- with latency, both directions read the partner's bitmask as it was
+  ``delay`` ticks ago, via a ring of past seen-states (delay lines).
+
+Counter mapping (documented deviation — anti-entropy has no per-share
+forwarding): ``received``/``forwarded`` count newly acquired shares as in
+the reference; ``sent`` counts shares transmitted in digests (one digest to
+one partner per round).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2p_gossip_tpu.engine.sync import DeviceGraph
+from p2p_gossip_tpu.models.generation import Schedule
+from p2p_gossip_tpu.models.topology import Graph
+from p2p_gossip_tpu.ops import bitmask
+from p2p_gossip_tpu.ops.segment import scatter_or
+from p2p_gossip_tpu.utils.stats import NodeStats
+
+
+def _select_partners(key, ell_idx, ell_delay, degree):
+    """One uniform-random neighbor (and its edge delay) per node."""
+    n, _ = ell_idx.shape
+    k = jax.random.randint(
+        key, (n,), minval=0, maxval=jnp.maximum(degree, 1)
+    )
+    rows = jnp.arange(n)
+    return ell_idx[rows, k], ell_delay[rows, k]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("chunk_size", "horizon", "record_coverage")
+)
+def _run_pushpull(
+    dg: DeviceGraph,
+    origins: jnp.ndarray,
+    gen_ticks: jnp.ndarray,
+    key: jnp.ndarray,
+    partners_override: jnp.ndarray,   # (horizon, N) int32 or (0,) when unused
+    *,
+    chunk_size: int,
+    horizon: int,
+    record_coverage: bool = False,
+):
+    n, w = dg.n, bitmask.num_words(chunk_size)
+    slots = jnp.arange(chunk_size, dtype=jnp.int32)
+    ring = dg.ring_size
+    use_override = partners_override.ndim == 2
+
+    state = (
+        jnp.zeros((n, w), dtype=jnp.uint32),          # seen
+        jnp.zeros((ring, n, w), dtype=jnp.uint32),    # seen history ring
+        jnp.zeros((n,), dtype=jnp.int32),             # received
+        jnp.zeros((n,), dtype=jnp.uint32),            # sent lo (64-bit pair)
+        jnp.zeros((n,), dtype=jnp.uint32),            # sent hi
+    )
+
+    def step(state, t):
+        seen, hist, received, sent_lo, sent_hi = state
+        if use_override:
+            partners = partners_override[t]
+            delay = jnp.ones((n,), dtype=jnp.int32)
+        elif dg.uniform_delay is not None:
+            # DeviceGraph stages a placeholder delay array on the fast path —
+            # the real delay is the static scalar.
+            key_t = jax.random.fold_in(key, t)
+            partners, _ = _select_partners(
+                key_t, dg.ell_idx, jnp.zeros_like(dg.ell_idx), dg.degree
+            )
+            delay = jnp.full((n,), dg.uniform_delay, dtype=jnp.int32)
+        else:
+            partners, delay = _select_partners(
+                jax.random.fold_in(key, t), dg.ell_idx, dg.ell_delay, dg.degree
+            )
+        # Partner state as of `delay` ticks ago (delay lines over seen).
+        flat = hist.reshape(ring * n, w)
+        slot = jnp.mod(t - delay, ring)
+        remote = flat[slot * n + partners]            # pull payload (N, W)
+        my_old = flat[slot * n + jnp.arange(n)]       # what the partner pulls
+        pushed = scatter_or(n, partners, my_old)
+        gen_active = gen_ticks == t
+        gen_bits = bitmask.slot_scatter(n, w, origins, slots, gen_active)
+        incoming = (remote | pushed) & ~seen
+        newly_cnt = bitmask.popcount_rows(incoming)
+        # One digest per round to one partner (64-bit accumulation: digest
+        # popcounts reach num_shares per round, horizon rounds overflow i32).
+        sent_lo, sent_hi = bitmask.add_u64(
+            sent_lo, sent_hi, bitmask.popcount_rows(my_old)
+        )
+        seen = seen | incoming | gen_bits
+        received = received + newly_cnt
+        hist = hist.at[jnp.mod(t, ring)].set(seen)
+        cov = (
+            bitmask.coverage_per_slot(seen, chunk_size)
+            if record_coverage
+            else jnp.zeros((0,), jnp.int32)  # nothing stacked when unused
+        )
+        return (seen, hist, received, sent_lo, sent_hi), cov
+
+    state, coverage = jax.lax.scan(
+        step, state, jnp.arange(horizon, dtype=jnp.int32)
+    )
+    seen, _, received, sent_lo, sent_hi = state
+    return seen, received, (sent_lo, sent_hi), coverage
+
+
+def run_pushpull_sim(
+    graph: Graph,
+    schedule: Schedule,
+    horizon_ticks: int,
+    ell_delays: np.ndarray | None = None,
+    constant_delay: int = 1,
+    seed: int = 0,
+    record_coverage: bool = False,
+    partners_override: np.ndarray | None = None,
+    device_graph: DeviceGraph | None = None,
+    chunk_size: int = 4096,
+):
+    """Push-pull anti-entropy for ``horizon_ticks`` rounds.
+
+    Shares are processed in fixed-size chunks like the sync engine; partner
+    selection is keyed only by (seed, round), so every chunk sees the same
+    exchange pattern and counters are exactly additive.
+
+    ``partners_override`` (horizon, N) pins each round's partner choice —
+    used by the tests to compare against a numpy oracle with identical
+    randomness. Returns (stats, coverage or None).
+    """
+    dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
+    chunk_size = min(chunk_size, max(32, schedule.num_shares))
+    chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
+    override = (
+        jnp.asarray(partners_override, dtype=jnp.int32)
+        if partners_override is not None
+        else jnp.zeros((0,), dtype=jnp.int32)
+    )
+    key = jax.random.PRNGKey(seed)
+
+    received = np.zeros(graph.n, dtype=np.int64)
+    sent = np.zeros(graph.n, dtype=np.int64)
+    cov_chunks = []
+    for chunk in schedule.chunk(chunk_size) or [schedule]:
+        origins, gen_ticks = chunk.padded(chunk_size, horizon_ticks)
+        _, r, (s_lo, s_hi), coverage = _run_pushpull(
+            dg,
+            jnp.asarray(origins),
+            jnp.asarray(gen_ticks),
+            key,
+            override,
+            chunk_size=chunk_size,
+            horizon=horizon_ticks,
+            record_coverage=record_coverage,
+        )
+        received += np.asarray(r, dtype=np.int64)
+        sent += bitmask.combine_u64(s_lo, s_hi)
+        if record_coverage:
+            cov_chunks.append(np.asarray(coverage)[:, : chunk.num_shares])
+
+    # Digest traffic is per-round per-node regardless of chunking: chunking
+    # splits the digest into per-chunk digests, so `sent` stays exact.
+    generated = schedule.generated_per_node(horizon_ticks).astype(np.int64)
+    stats = NodeStats(
+        generated=generated,
+        received=received,
+        forwarded=received.copy(),
+        sent=sent,
+        processed=generated + received,
+        degree=graph.degree.astype(np.int64),
+    )
+    cov = np.concatenate(cov_chunks, axis=1) if record_coverage else None
+    return stats, cov
+
+
+def pushpull_oracle(
+    graph: Graph,
+    schedule: Schedule,
+    horizon_ticks: int,
+    partners: np.ndarray,
+) -> NodeStats:
+    """Plain-numpy specification of one-tick-delay push-pull with pinned
+    partner choices — the oracle the TPU engine is tested against."""
+    n = graph.n
+    s = schedule.num_shares
+    seen = np.zeros((n, s), dtype=bool)
+    hist = [np.zeros((n, s), dtype=bool) for _ in range(2)]
+    received = np.zeros(n, dtype=np.int64)
+    sent = np.zeros(n, dtype=np.int64)
+    for t in range(horizon_ticks):
+        old = hist[(t - 1) % 2]
+        p = partners[t]
+        incoming = old[p]  # pull
+        for i in range(n):  # push
+            incoming[p[i]] = incoming[p[i]] | old[i]
+        sent += old.sum(axis=1)
+        newly = incoming & ~seen
+        received += newly.sum(axis=1)
+        seen |= newly
+        gen_now = schedule.gen_ticks == t
+        seen[schedule.origins[gen_now], np.flatnonzero(gen_now)] = True
+        hist[t % 2] = seen.copy()
+    generated = schedule.generated_per_node(horizon_ticks).astype(np.int64)
+    return NodeStats(
+        generated=generated,
+        received=received,
+        forwarded=received.copy(),
+        sent=sent,
+        processed=generated + received,
+        degree=graph.degree.astype(np.int64),
+    )

@@ -54,6 +54,26 @@ def slot_scatter(
     return out.at[rows, word].add(vals, mode="drop")
 
 
+def add_u64(lo: jnp.ndarray, hi: jnp.ndarray, x: jnp.ndarray):
+    """64-bit accumulation as a uint32 (lo, hi) pair — jax runs with x64
+    disabled, and long simulations overflow int32 counters (e.g. push-pull
+    digest traffic: popcounts up to num_shares added every round)."""
+    lo = lo.astype(jnp.uint32)
+    x = x.astype(jnp.uint32)
+    new_lo = lo + x
+    carry = (new_lo < x).astype(jnp.uint32)  # uint32 wraparound detect
+    return new_lo, hi + carry
+
+
+def combine_u64(lo: jnp.ndarray, hi: jnp.ndarray):
+    """Host-side: (lo, hi) uint32 pair -> int64 numpy array."""
+    import numpy as np
+
+    return np.asarray(hi, dtype=np.int64) * (1 << 32) + np.asarray(
+        lo, dtype=np.int64
+    )
+
+
 def coverage_per_slot(seen: jnp.ndarray, n_slots: int) -> jnp.ndarray:
     """Per-share coverage: (N, W) seen-bitmask -> (S,) int32 node counts.
 

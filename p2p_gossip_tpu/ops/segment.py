@@ -1,0 +1,58 @@
+"""Scatter-OR via sort + segmented OR-scan.
+
+XLA has scatter-add/min/max but no scatter-OR, and bitmask rows can't ride
+scatter-max. The TPU-idiomatic construction: sort payload rows by destination,
+OR-reduce each run of equal destinations with a segmented associative scan,
+and write one row per distinct destination (collision-free, so a plain
+scatter suffices). O(N log N) sort + O(N) scan per call — all dense,
+XLA-friendly ops. Used by the push direction of push-pull anti-entropy
+(models/protocols.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def scatter_or(
+    n_rows: int,
+    dst: jnp.ndarray,     # (M,) int32 destination row per payload
+    payload: jnp.ndarray, # (M, W) uint32 rows to OR into dst
+    mask: jnp.ndarray | None = None,  # (M,) bool — inactive entries dropped
+) -> jnp.ndarray:
+    """Returns (n_rows, W) uint32: OR of all payload rows per destination."""
+    m, w = payload.shape
+    if mask is not None:
+        # Inactive entries go to a sentinel row that is sliced away.
+        dst = jnp.where(mask, dst, n_rows)
+        payload = jnp.where(mask[:, None], payload, jnp.uint32(0))
+
+    order = jnp.argsort(dst)
+    dst_s = dst[order]
+    pay_s = payload[order]
+
+    # Segment heads: first element of each run of equal destinations.
+    heads = jnp.concatenate(
+        [jnp.ones((1,), bool), dst_s[1:] != dst_s[:-1]]
+    )
+
+    # Segmented inclusive OR-scan: (value, head-flag) pairs under the usual
+    # segmented-scan combiner.
+    def combine(a, b):
+        va, fa = a
+        vb, fb = b
+        return jnp.where(fb[..., None], vb, va | vb), fa | fb
+
+    vals, _ = lax.associative_scan(
+        combine, (pay_s, heads.astype(jnp.uint32)), axis=0
+    )
+
+    # Last element of each segment carries the full OR: positions where the
+    # NEXT element starts a new segment (or the end of the array).
+    tails = jnp.concatenate([heads[1:], jnp.ones((1,), bool)])
+    rows = jnp.where(tails, dst_s, n_rows)
+    out = jnp.zeros((n_rows + 1, w), dtype=jnp.uint32)
+    out = out.at[rows].max(jnp.where(tails[:, None], vals, jnp.uint32(0)))
+    return out[:n_rows]

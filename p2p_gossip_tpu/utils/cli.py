@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--baM", type=int, default=3, help="Edges per node for --topology ba")
     p.add_argument(
+        "--protocol", choices=("push", "pushpull"), default="push",
+        help="Gossip protocol: push flooding (reference) or push-pull "
+        "anti-entropy (tpu backend only)",
+    )
+    p.add_argument(
         "--genModel", choices=("uniform", "poisson"), default="uniform",
         help="Share generation model (uniform = reference's U(genLo, genHi))",
     )
@@ -124,8 +129,19 @@ def run(argv=None) -> int:
         else []
     )
 
+    if args.protocol == "pushpull" and args.backend != "tpu":
+        print("error: --protocol pushpull requires --backend tpu", file=sys.stderr)
+        return 2
+
     t0 = time.perf_counter()
-    if args.backend == "tpu":
+    if args.protocol == "pushpull":
+        from p2p_gossip_tpu.models.protocols import run_pushpull_sim
+
+        stats, _ = run_pushpull_sim(
+            g, sched, horizon, ell_delays=delays, seed=args.seed,
+            chunk_size=args.chunkSize,
+        )
+    elif args.backend == "tpu":
         from p2p_gossip_tpu.engine.sync import run_sync_sim
 
         stats = run_sync_sim(

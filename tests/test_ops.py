@@ -123,6 +123,30 @@ def test_propagate_blocked_equals_reference():
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_scatter_or_matches_numpy():
+    from p2p_gossip_tpu.ops.segment import scatter_or
+
+    rng = np.random.default_rng(7)
+    m, n_rows, w = 257, 40, 3
+    dst = rng.integers(0, n_rows, m).astype(np.int32)
+    payload = rng.integers(0, 2**32, size=(m, w), dtype=np.uint64).astype(np.uint32)
+    mask = rng.random(m) < 0.8
+    want = np.zeros((n_rows, w), dtype=np.uint32)
+    for i in range(m):
+        if mask[i]:
+            want[dst[i]] |= payload[i]
+    got = np.asarray(
+        scatter_or(n_rows, jnp.asarray(dst), jnp.asarray(payload), jnp.asarray(mask))
+    )
+    np.testing.assert_array_equal(got, want)
+    # No mask: every row lands.
+    want2 = np.zeros((n_rows, w), dtype=np.uint32)
+    for i in range(m):
+        want2[dst[i]] |= payload[i]
+    got2 = np.asarray(scatter_or(n_rows, jnp.asarray(dst), jnp.asarray(payload)))
+    np.testing.assert_array_equal(got2, want2)
+
+
 def test_delay_symmetry():
     g = erdos_renyi(30, 0.2, seed=8)
     delays = lognormal_delays(g, seed=11)
