@@ -29,7 +29,10 @@ import numpy as np
 
 from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.models.generation import Schedule
+from p2p_gossip_tpu.utils import logging as p2plog
 from p2p_gossip_tpu.utils.stats import NodeStats
+
+log = p2plog.get_logger("Engine.Event")
 
 
 def run_event_sim(
@@ -111,6 +114,15 @@ def run_event_sim(
             )
             bi += 1
 
+    log.info(
+        f"starting event simulation: {n} nodes, {graph.num_edges} links, "
+        f"{schedule.num_shares} shares, horizon {horizon_ticks} ticks"
+    )
+    # Per-event tracing mirrors the reference's NS_LOG_INFO lines in
+    # GenerateAndGossipShare / ReceiveShare (p2pnode.cc:121,161); guarded so a
+    # silent run pays one compare per event.
+    trace = log.enabled(p2plog.LOG_LOGIC)
+
     while heap:
         t, _, kind, node, share = heapq.heappop(heap)
         take_snapshots(t)
@@ -118,15 +130,26 @@ def run_event_sim(
         if kind == 0:
             generated[node] += 1
             seen[node].add(share)
+            if trace:
+                log.debug(f"Node {node} generated share {share}", sim_time=t)
             if arrival_ticks is not None and share < arrival_ticks.shape[0]:
                 arrival_ticks[share, node] = t
             broadcast(node, share, t)
         else:
             if share in seen[node]:
+                if trace:
+                    log.logic(
+                        f"Node {node} dropped duplicate share {share}", sim_time=t
+                    )
                 continue
             seen[node].add(share)
             received[node] += 1
             forwarded[node] += 1
+            if trace:
+                log.debug(
+                    f"Node {node} received new share {share}, forwarding",
+                    sim_time=t,
+                )
             if arrival_ticks is not None and share < arrival_ticks.shape[0]:
                 arrival_ticks[share, node] = t
             broadcast(node, share, t)
@@ -140,6 +163,7 @@ def run_event_sim(
         degree=graph.degree.astype(np.int64),
     )
     take_snapshots(horizon_ticks)
+    log.info(f"event simulation done: {events_processed} events processed")
     stats.extra["events_processed"] = events_processed
     if boundaries:
         stats.extra["snapshots"] = snapshots

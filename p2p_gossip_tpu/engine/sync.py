@@ -41,7 +41,10 @@ from p2p_gossip_tpu.ops.ell import (
     propagate,
     propagate_uniform,
 )
+from p2p_gossip_tpu.utils import logging as p2plog
 from p2p_gossip_tpu.utils.stats import NodeStats
+
+log = p2plog.get_logger("Engine.Sync")
 
 DEFAULT_CHUNK_SIZE = 512
 
@@ -243,15 +246,28 @@ def run_sync_sim(
     # Round chunk size up to whole words.
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
 
+    log.info(
+        f"starting sync simulation: {graph.n} nodes, {graph.num_edges} links, "
+        f"{schedule.num_shares} shares in chunks of {chunk_size}, horizon "
+        f"{horizon_ticks} ticks, ring {dg.ring_size}"
+        + (f", uniform delay {dg.uniform_delay}" if dg.uniform_delay else "")
+    )
     received = np.zeros(graph.n, dtype=np.int64)
     sent = np.zeros(graph.n, dtype=np.int64)
-    for chunk in schedule.chunk(chunk_size):
+    for ci, chunk in enumerate(schedule.chunk(chunk_size)):
         live = chunk.gen_ticks < horizon_ticks
         if not live.any():
             continue
         origins, gen_ticks = chunk.padded(chunk_size, horizon_ticks)
-        t_start = jnp.asarray(int(chunk.gen_ticks[live].min()), dtype=jnp.int32)
-        last_gen = jnp.asarray(int(chunk.gen_ticks[live].max()), dtype=jnp.int32)
+        first_t = int(chunk.gen_ticks[live].min())
+        last_t = int(chunk.gen_ticks[live].max())
+        if log.enabled(p2plog.LOG_DEBUG):
+            log.debug(
+                f"chunk {ci}: {int(live.sum())} live shares, gen ticks "
+                f"[{first_t}, {last_t}]"
+            )
+        t_start = jnp.asarray(first_t, dtype=jnp.int32)
+        last_gen = jnp.asarray(last_t, dtype=jnp.int32)
         _, r, s = _run_chunk_while(
             dg, jnp.asarray(origins), jnp.asarray(gen_ticks), t_start, last_gen,
             chunk_size=chunk_size, horizon=horizon_ticks, block=block,

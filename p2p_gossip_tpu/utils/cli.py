@@ -85,12 +85,27 @@ def build_parser() -> argparse.ArgumentParser:
         "--perNodeStats", action="store_true", default=None,
         help="Print per-node lines (default: on for N <= 1000)",
     )
+    p.add_argument(
+        "--log", type=str, default="",
+        help="NS_LOG-style component log spec, e.g. "
+        "'Engine.Event=debug:Engine.Sync=info' or '*=info' "
+        "(also honors the P2P_LOG environment variable)",
+    )
     return p
 
 
 def run(argv=None) -> int:
     args = build_parser().parse_args(argv)
     tick_dt = args.Latency / 1000.0
+    from p2p_gossip_tpu.utils import logging as p2plog
+
+    if args.log:
+        try:
+            p2plog.configure(args.log)
+        except ValueError as e:
+            print(f"error: --log: {e}", file=sys.stderr)
+            return 2
+    p2plog.set_time_resolution(tick_dt)
     horizon = int(round(args.simTime / tick_dt))
 
     if args.topology == "er":

@@ -1,0 +1,114 @@
+"""utils/logging.py — the NS_LOG-style component log facility."""
+
+import io
+
+import numpy as np
+import pytest
+
+from p2p_gossip_tpu.models.generation import Schedule
+from p2p_gossip_tpu.models.topology import ring_graph
+from p2p_gossip_tpu.utils import logging as p2plog
+
+
+@pytest.fixture(autouse=True)
+def capture():
+    """Route log output to a buffer and reset rules around each test."""
+    buf = io.StringIO()
+    p2plog.set_stream(buf)
+    p2plog.disable("*")
+    yield buf
+    p2plog.disable("*")
+    p2plog.set_stream(None)
+    p2plog.set_time_resolution(1.0)
+
+
+def test_disabled_by_default(capture):
+    log = p2plog.get_logger("TestComp")
+    log.error("boom")
+    log.info("hello")
+    assert capture.getvalue() == ""
+
+
+def test_level_filtering(capture):
+    log = p2plog.get_logger("TestComp")
+    p2plog.enable("TestComp", p2plog.LOG_INFO)
+    log.error("e")
+    log.warn("w")
+    log.info("i")
+    log.debug("d")  # above INFO -> suppressed
+    lines = capture.getvalue().strip().splitlines()
+    assert lines == [
+        "[TestComp] ERROR: e",
+        "[TestComp] WARN: w",
+        "[TestComp] INFO: i",
+    ]
+
+
+def test_sim_time_prefix(capture):
+    log = p2plog.get_logger("TestComp")
+    p2plog.enable("TestComp", "debug")
+    log.debug("tick", sim_time=0.005)
+    assert capture.getvalue() == "+0.005s [TestComp] DEBUG: tick\n"
+
+
+def test_time_resolution_maps_ticks_to_seconds(capture):
+    log = p2plog.get_logger("TestComp")
+    p2plog.enable("TestComp", "debug")
+    p2plog.set_time_resolution(0.005)
+    log.debug("tick", sim_time=400)  # 400 ticks at 5 ms
+    assert capture.getvalue() == "+2s [TestComp] DEBUG: tick\n"
+
+
+def test_configure_spec_and_wildcard(capture):
+    a = p2plog.get_logger("CompA")
+    b = p2plog.get_logger("CompB")
+    p2plog.configure("CompA=warn:*=error")
+    a.warn("aw")
+    b.warn("bw")  # wildcard gave B only ERROR
+    b.error("be")
+    # Components registered AFTER the wildcard rule also pick it up.
+    c = p2plog.get_logger("CompC")
+    c.error("ce")
+    c.info("ci")
+    lines = capture.getvalue().strip().splitlines()
+    assert lines == ["[CompA] WARN: aw", "[CompB] ERROR: be", "[CompC] ERROR: ce"]
+
+
+def test_bare_component_means_debug(capture):
+    p2plog.configure("CompD")
+    assert p2plog.get_logger("CompD").enabled(p2plog.LOG_DEBUG)
+
+
+def test_parse_level_variants():
+    assert p2plog.parse_level("LOG_INFO") == p2plog.LOG_INFO
+    assert p2plog.parse_level("logic") == p2plog.LOG_LOGIC
+    assert p2plog.parse_level("5") == 5
+    with pytest.raises(ValueError):
+        p2plog.parse_level("verbose")
+
+
+def test_event_engine_traces(capture):
+    """Per-event NS_LOG-style lines from the event engine at debug level."""
+    from p2p_gossip_tpu.engine.event import run_event_sim
+
+    p2plog.enable("Engine.Event", p2plog.LOG_DEBUG)
+    g = ring_graph(4)
+    sched = Schedule(4, np.array([0], dtype=np.int32), np.array([0], dtype=np.int32))
+    run_event_sim(g, sched, horizon_ticks=10)
+    out = capture.getvalue()
+    assert "Node 0 generated share 0" in out
+    assert "received new share 0" in out
+    assert "starting event simulation: 4 nodes" in out
+
+
+def test_cli_log_flag(capture, capsys):
+    from p2p_gossip_tpu.utils.cli import run
+
+    rc = run(
+        [
+            "--numNodes", "6", "--simTime", "4", "--backend", "event",
+            "--log", "Engine.Event=info",
+        ]
+    )
+    assert rc == 0
+    assert "starting event simulation: 6 nodes" in capture.getvalue()
