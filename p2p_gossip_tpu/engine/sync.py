@@ -235,16 +235,53 @@ def run_sync_sim(
     chunk_size: int = DEFAULT_CHUNK_SIZE,
     block: int = DEFAULT_DEGREE_BLOCK,
     device_graph: DeviceGraph | None = None,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 1,
+    stop_after_chunks: int | None = None,
 ) -> NodeStats:
     """Run the full simulation on the synchronous engine.
 
     Drop-in counterpart of `engine.event.run_event_sim`: same inputs,
     identical per-node counters (the parity tests assert exactly this).
+
+    With ``checkpoint_path``, accumulated counters are written atomically
+    every ``checkpoint_every`` chunks, and a run restarted with the same
+    inputs resumes after the last completed chunk (a checkpoint from any
+    *different* configuration is detected by fingerprint and ignored —
+    see utils/checkpoint.py). ``stop_after_chunks`` ends the run early
+    after that many chunks this call (simulating interruption; used by
+    tests and incremental drivers).
     """
     dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
     chunk_size = min(chunk_size, max(32, schedule.num_shares))
     # Round chunk size up to whole words.
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
+
+    start_chunk = 0
+    ckpt_fp = None
+    if checkpoint_path is not None:
+        if checkpoint_every < 1:
+            raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
+        from p2p_gossip_tpu.utils import checkpoint as ckpt
+
+        ckpt_fp = ckpt.fingerprint(
+            "sync_sim", graph.n, graph.edges(), schedule.origins,
+            schedule.gen_ticks, horizon_ticks, chunk_size, ell_delays,
+            constant_delay,
+        )
+        loaded = ckpt.load_checkpoint(checkpoint_path)
+        if loaded is not None:
+            arrays, meta = loaded
+            if meta.get("fingerprint") == ckpt_fp:
+                start_chunk = int(meta["next_chunk"])
+                log.info(
+                    f"resuming from {checkpoint_path} at chunk {start_chunk}"
+                )
+            else:
+                log.warn(
+                    f"checkpoint {checkpoint_path} is from a different run "
+                    "(fingerprint mismatch); starting fresh"
+                )
 
     log.info(
         f"starting sync simulation: {graph.n} nodes, {graph.num_edges} links, "
@@ -254,26 +291,48 @@ def run_sync_sim(
     )
     received = np.zeros(graph.n, dtype=np.int64)
     sent = np.zeros(graph.n, dtype=np.int64)
-    for ci, chunk in enumerate(schedule.chunk(chunk_size)):
-        live = chunk.gen_ticks < horizon_ticks
-        if not live.any():
-            continue
-        origins, gen_ticks = chunk.padded(chunk_size, horizon_ticks)
-        first_t = int(chunk.gen_ticks[live].min())
-        last_t = int(chunk.gen_ticks[live].max())
-        if log.enabled(p2plog.LOG_DEBUG):
-            log.debug(
-                f"chunk {ci}: {int(live.sum())} live shares, gen ticks "
-                f"[{first_t}, {last_t}]"
-            )
-        t_start = jnp.asarray(first_t, dtype=jnp.int32)
-        last_gen = jnp.asarray(last_t, dtype=jnp.int32)
-        _, r, s = _run_chunk_while(
-            dg, jnp.asarray(origins), jnp.asarray(gen_ticks), t_start, last_gen,
-            chunk_size=chunk_size, horizon=horizon_ticks, block=block,
+    if start_chunk:
+        received += arrays["received"].astype(np.int64)
+        sent += arrays["sent"].astype(np.int64)
+
+    def save(next_chunk: int) -> None:
+        ckpt.save_checkpoint(
+            checkpoint_path,
+            {"received": received, "sent": sent},
+            {"fingerprint": ckpt_fp, "next_chunk": next_chunk},
         )
-        received += np.asarray(r, dtype=np.int64)
-        sent += np.asarray(s, dtype=np.int64)
+
+    chunks = schedule.chunk(chunk_size)
+    done_this_call = 0
+    for ci, chunk in enumerate(chunks):
+        if ci < start_chunk:
+            continue
+        if stop_after_chunks is not None and done_this_call >= stop_after_chunks:
+            break
+        live = chunk.gen_ticks < horizon_ticks
+        if live.any():
+            origins, gen_ticks = chunk.padded(chunk_size, horizon_ticks)
+            first_t = int(chunk.gen_ticks[live].min())
+            last_t = int(chunk.gen_ticks[live].max())
+            if log.enabled(p2plog.LOG_DEBUG):
+                log.debug(
+                    f"chunk {ci}: {int(live.sum())} live shares, gen ticks "
+                    f"[{first_t}, {last_t}]"
+                )
+            t_start = jnp.asarray(first_t, dtype=jnp.int32)
+            last_gen = jnp.asarray(last_t, dtype=jnp.int32)
+            _, r, s = _run_chunk_while(
+                dg, jnp.asarray(origins), jnp.asarray(gen_ticks), t_start,
+                last_gen,
+                chunk_size=chunk_size, horizon=horizon_ticks, block=block,
+            )
+            received += np.asarray(r, dtype=np.int64)
+            sent += np.asarray(s, dtype=np.int64)
+        done_this_call += 1
+        if checkpoint_path is not None and (
+            done_this_call % checkpoint_every == 0 or ci == len(chunks) - 1
+        ):
+            save(ci + 1)
 
     generated = schedule.generated_per_node(horizon_ticks).astype(np.int64)
     degree = np.asarray(dg.degree, dtype=np.int64)

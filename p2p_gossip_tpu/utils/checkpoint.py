@@ -1,0 +1,88 @@
+"""Checkpoint/resume for long simulations.
+
+The reference has none of this: an NS-3 run that dies restarts from zero
+(`Simulator::Run` is monolithic). Here the synchronous TPU engine processes
+shares in independent fixed-size chunks (engine/sync.py), so the natural
+checkpoint boundary is *between chunks*: the accumulated per-node counters
+plus the index of the next chunk fully determine the rest of the run —
+schedules and topologies are deterministic from their seeds and are
+re-derived on resume, never serialized.
+
+A checkpoint is a single ``.npz`` holding the counter arrays, a JSON meta
+blob, and a **fingerprint** of everything that determines the run (topology
+edges, schedule, horizon, chunk size, delay model). A resume with a
+mismatched fingerprint ignores the file and starts fresh — resuming counters
+from a different run would silently corrupt results. Writes are atomic
+(tmp + ``os.replace``) so an interrupt mid-save never leaves a torn file.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import zipfile
+
+import numpy as np
+
+from p2p_gossip_tpu.utils import logging as p2plog
+
+log = p2plog.get_logger("Checkpoint")
+
+_META_KEY = "__meta_json__"
+_FORMAT_VERSION = 1
+
+
+def fingerprint(*parts) -> str:
+    """SHA-256 over an ordered mix of arrays / scalars / strings."""
+    h = hashlib.sha256()
+    for part in parts:
+        if part is None:
+            h.update(b"\x00none")
+        elif isinstance(part, np.ndarray):
+            h.update(np.ascontiguousarray(part).tobytes())
+            h.update(str(part.dtype).encode())
+            h.update(str(part.shape).encode())
+        else:
+            h.update(repr(part).encode())
+        h.update(b"\x1f")
+    return h.hexdigest()
+
+
+def save_checkpoint(path: str, arrays: dict[str, np.ndarray], meta: dict) -> None:
+    """Atomically write ``arrays`` + ``meta`` to ``path`` (.npz)."""
+    meta = dict(meta, format_version=_FORMAT_VERSION)
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(
+            f,
+            **arrays,
+            **{_META_KEY: np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)},
+        )
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    log.debug(f"saved checkpoint to {path}: {meta}")
+
+
+def load_checkpoint(path: str) -> tuple[dict[str, np.ndarray], dict] | None:
+    """Read a checkpoint; None if missing or unreadable."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            arrays = {k: z[k] for k in z.files if k != _META_KEY}
+            meta = json.loads(bytes(z[_META_KEY]).decode())
+    except (
+        OSError, ValueError, KeyError, json.JSONDecodeError,
+        zipfile.BadZipFile,
+    ) as e:
+        log.warn(f"ignoring unreadable checkpoint {path}: {e}")
+        return None
+    if meta.get("format_version") != _FORMAT_VERSION:
+        log.warn(
+            f"ignoring checkpoint {path}: format version "
+            f"{meta.get('format_version')} != {_FORMAT_VERSION}"
+        )
+        return None
+    return arrays, meta

@@ -86,6 +86,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="Print per-node lines (default: on for N <= 1000)",
     )
     p.add_argument(
+        "--checkpoint", type=str, default="",
+        help="Checkpoint file: save progress between share chunks and resume "
+        "an interrupted run from it (tpu backend only)",
+    )
+    p.add_argument(
+        "--checkpointEvery", type=int, default=1,
+        help="Chunks between checkpoint writes (default 1)",
+    )
+    p.add_argument(
         "--log", type=str, default="",
         help="NS_LOG-style component log spec, e.g. "
         "'Engine.Event=debug:Engine.Sync=info' or '*=info' "
@@ -147,6 +156,15 @@ def run(argv=None) -> int:
     if args.protocol == "pushpull" and args.backend != "tpu":
         print("error: --protocol pushpull requires --backend tpu", file=sys.stderr)
         return 2
+    if args.checkpoint and (args.backend != "tpu" or args.protocol != "push"):
+        print(
+            "error: --checkpoint requires --backend tpu --protocol push",
+            file=sys.stderr,
+        )
+        return 2
+    if args.checkpointEvery < 1:
+        print("error: --checkpointEvery must be >= 1", file=sys.stderr)
+        return 2
 
     t0 = time.perf_counter()
     if args.protocol == "pushpull":
@@ -160,7 +178,9 @@ def run(argv=None) -> int:
         from p2p_gossip_tpu.engine.sync import run_sync_sim
 
         stats = run_sync_sim(
-            g, sched, horizon, ell_delays=delays, chunk_size=args.chunkSize
+            g, sched, horizon, ell_delays=delays, chunk_size=args.chunkSize,
+            checkpoint_path=args.checkpoint or None,
+            checkpoint_every=args.checkpointEvery,
         )
     elif args.backend == "native":
         from p2p_gossip_tpu.runtime.native import run_native_sim

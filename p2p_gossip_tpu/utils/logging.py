@@ -26,8 +26,7 @@ from __future__ import annotations
 
 import os
 import sys
-import time
-from typing import Callable, TextIO
+from typing import TextIO
 
 # Severity order follows ns3::LogLevel: a component enabled at level L emits
 # everything with severity <= L.
@@ -54,11 +53,11 @@ _NAME_LEVELS["level_all"] = LOG_ALL
 _NAME_LEVELS["off"] = 0
 
 _REGISTRY: dict[str, "LogComponent"] = {}
-_DEFAULT_LEVEL = 0  # applied to components matching no explicit rule
+# Errors and warnings are visible by default (a silently discarded checkpoint
+# or a bad P2P_LOG spec must reach stderr); everything chattier is opt-in.
+_DEFAULT_LEVEL = LOG_WARN
 _RULES: dict[str, int] = {}  # component (or "*") -> level
 _STREAM: TextIO | None = None  # None => sys.stderr at call time
-_CLOCK: Callable[[], float] = time.perf_counter
-_EPOCH = _CLOCK()
 # Engines log simulation time in integer ticks; the CLI maps ticks to seconds
 # (NS-3's Time::SetResolution analog) so prefixes read like NS_LOG's "+1.5s".
 _TIME_RESOLUTION = 1.0
@@ -153,16 +152,15 @@ def enable(component: str = "*", level: int | str = LOG_INFO) -> None:
 
 
 def disable(component: str = "*") -> None:
-    """Silence ``component``, or everything with ``"*"``."""
+    """Silence ``component`` (even under an active wildcard rule), or
+    everything — including components registered later — with ``"*"``."""
     if component == "*":
         _RULES.clear()
+        _RULES["*"] = 0
         for comp in _REGISTRY.values():
             comp.level = 0
     else:
-        _RULES.pop(component, None)
-        comp = _REGISTRY.get(component)
-        if comp is not None:
-            comp.level = _RULES.get("*", 0)
+        enable(component, 0)
 
 
 def configure(spec: str) -> None:

@@ -1,0 +1,139 @@
+"""Checkpoint/resume — utils/checkpoint.py + engine/sync.py integration."""
+
+import numpy as np
+import pytest
+
+from p2p_gossip_tpu.engine.sync import run_sync_sim
+from p2p_gossip_tpu.models.generation import uniform_renewal_schedule
+from p2p_gossip_tpu.models.topology import erdos_renyi
+from p2p_gossip_tpu.utils import checkpoint as ckpt
+
+
+def test_save_load_roundtrip(tmp_path):
+    path = str(tmp_path / "c.npz")
+    arrays = {
+        "received": np.arange(7, dtype=np.int64),
+        "sent": np.full(7, 3, dtype=np.int64),
+    }
+    ckpt.save_checkpoint(path, arrays, {"fingerprint": "abc", "next_chunk": 4})
+    loaded = ckpt.load_checkpoint(path)
+    assert loaded is not None
+    got, meta = loaded
+    np.testing.assert_array_equal(got["received"], arrays["received"])
+    np.testing.assert_array_equal(got["sent"], arrays["sent"])
+    assert meta["fingerprint"] == "abc" and meta["next_chunk"] == 4
+
+
+def test_load_missing_and_corrupt(tmp_path):
+    assert ckpt.load_checkpoint(str(tmp_path / "nope.npz")) is None
+    bad = tmp_path / "bad.npz"
+    bad.write_bytes(b"not a zipfile")
+    assert ckpt.load_checkpoint(str(bad)) is None
+    # Truncated file that still starts with the zip magic (BadZipFile path).
+    truncated = tmp_path / "trunc.npz"
+    truncated.write_bytes(b"PK\x03\x04" + b"\x00" * 16)
+    assert ckpt.load_checkpoint(str(truncated)) is None
+
+
+def test_checkpoint_every_validated(tmp_path, sim_setup):
+    g, sched, horizon = sim_setup
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        run_sync_sim(
+            g, sched, horizon, chunk_size=32,
+            checkpoint_path=str(tmp_path / "c.npz"), checkpoint_every=0,
+        )
+    from p2p_gossip_tpu.utils.cli import run
+
+    assert run(["--backend", "tpu", "--checkpoint", "x", "--checkpointEvery", "0"]) == 2
+
+
+def test_fingerprint_sensitivity():
+    a = np.arange(10, dtype=np.int32)
+    assert ckpt.fingerprint("x", a, 5) == ckpt.fingerprint("x", a.copy(), 5)
+    assert ckpt.fingerprint("x", a, 5) != ckpt.fingerprint("x", a, 6)
+    assert ckpt.fingerprint("x", a, 5) != ckpt.fingerprint("x", a + 1, 5)
+    # dtype matters even when bytes match
+    assert ckpt.fingerprint(a) != ckpt.fingerprint(a.view(np.uint32))
+    assert ckpt.fingerprint(None) != ckpt.fingerprint(0)
+
+
+@pytest.fixture
+def sim_setup():
+    g = erdos_renyi(30, 0.15, seed=3)
+    # Small chunks force several of them: ~200 shares / 32 -> ~7 chunks.
+    sched = uniform_renewal_schedule(30, 40.0, 0.1, seed=3)
+    horizon = 400
+    return g, sched, horizon
+
+
+def test_interrupted_run_resumes_to_identical_counters(tmp_path, sim_setup):
+    g, sched, horizon = sim_setup
+    path = str(tmp_path / "sim.npz")
+    full = run_sync_sim(g, sched, horizon, chunk_size=32)
+
+    partial = run_sync_sim(
+        g, sched, horizon, chunk_size=32,
+        checkpoint_path=path, stop_after_chunks=2,
+    )
+    # The partial run covered fewer shares than the full run.
+    assert partial.totals()["received"] < full.totals()["received"]
+    meta = ckpt.load_checkpoint(path)[1]
+    assert meta["next_chunk"] == 2
+
+    resumed = run_sync_sim(g, sched, horizon, chunk_size=32, checkpoint_path=path)
+    assert resumed.equal_counts(full)
+    # Final checkpoint marks every chunk done.
+    n_chunks = len(sched.chunk(32))
+    assert ckpt.load_checkpoint(path)[1]["next_chunk"] == n_chunks
+
+    # Resuming a finished run recomputes nothing and returns the same counters.
+    again = run_sync_sim(g, sched, horizon, chunk_size=32, checkpoint_path=path)
+    assert again.equal_counts(full)
+
+
+def test_mismatched_fingerprint_starts_fresh(tmp_path, sim_setup):
+    g, sched, horizon = sim_setup
+    path = str(tmp_path / "sim.npz")
+    run_sync_sim(
+        g, sched, horizon, chunk_size=32,
+        checkpoint_path=path, stop_after_chunks=2,
+    )
+    # Different horizon => different run: checkpoint must be ignored.
+    full_other = run_sync_sim(g, sched, horizon + 50, chunk_size=32)
+    resumed = run_sync_sim(
+        g, sched, horizon + 50, chunk_size=32, checkpoint_path=path
+    )
+    assert resumed.equal_counts(full_other)
+
+
+def test_checkpoint_every_batches_writes(tmp_path, sim_setup):
+    g, sched, horizon = sim_setup
+    path = str(tmp_path / "sim.npz")
+    run_sync_sim(
+        g, sched, horizon, chunk_size=32,
+        checkpoint_path=path, checkpoint_every=3, stop_after_chunks=4,
+    )
+    # 4 chunks done, writes at chunk 3 only -> checkpoint says next_chunk=3.
+    assert ckpt.load_checkpoint(path)[1]["next_chunk"] == 3
+    full = run_sync_sim(g, sched, horizon, chunk_size=32)
+    resumed = run_sync_sim(
+        g, sched, horizon, chunk_size=32, checkpoint_path=path,
+        checkpoint_every=3,
+    )
+    assert resumed.equal_counts(full)
+
+
+def test_cli_checkpoint_flag(tmp_path, capsys):
+    from p2p_gossip_tpu.utils.cli import run
+
+    path = str(tmp_path / "cli.npz")
+    rc = run(
+        [
+            "--numNodes", "12", "--simTime", "8", "--backend", "tpu",
+            "--chunkSize", "32", "--checkpoint", path,
+        ]
+    )
+    assert rc == 0
+    assert ckpt.load_checkpoint(path) is not None
+    # Rejected off the tpu backend.
+    assert run(["--backend", "event", "--checkpoint", path]) == 2

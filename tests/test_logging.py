@@ -29,6 +29,27 @@ def test_disabled_by_default(capture):
     assert capture.getvalue() == ""
 
 
+def test_default_level_is_warn(capture):
+    """With no rules at all, errors/warnings reach stderr; info doesn't —
+    a silently discarded checkpoint must never be invisible."""
+    p2plog._RULES.clear()
+    log = p2plog.get_logger("FreshComp")
+    log.error("e")
+    log.warn("w")
+    log.info("i")
+    lines = capture.getvalue().strip().splitlines()
+    assert lines == ["[FreshComp] ERROR: e", "[FreshComp] WARN: w"]
+
+
+def test_disable_overrides_wildcard(capture):
+    noisy = p2plog.get_logger("Noisy")
+    p2plog.enable("*", "info")
+    p2plog.disable("Noisy")
+    noisy.error("still silent")
+    p2plog.get_logger("Other").info("visible")
+    assert capture.getvalue() == "[Other] INFO: visible\n"
+
+
 def test_level_filtering(capture):
     log = p2plog.get_logger("TestComp")
     p2plog.enable("TestComp", p2plog.LOG_INFO)
