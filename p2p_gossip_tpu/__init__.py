@@ -18,7 +18,15 @@ and NetAnim-style visualization — re-architected for TPU:
   baselines.
 """
 
-from p2p_gossip_tpu.models.topology import Graph, erdos_renyi, barabasi_albert, ring_graph
+from p2p_gossip_tpu.models.topology import (
+    Graph,
+    erdos_renyi,
+    barabasi_albert,
+    ring_graph,
+    complete_graph,
+    watts_strogatz,
+    grid_graph,
+)
 from p2p_gossip_tpu.models.generation import uniform_renewal_schedule, poisson_schedule, Schedule
 from p2p_gossip_tpu.utils.stats import NodeStats
 
@@ -29,6 +37,9 @@ __all__ = [
     "erdos_renyi",
     "barabasi_albert",
     "ring_graph",
+    "complete_graph",
+    "watts_strogatz",
+    "grid_graph",
     "Schedule",
     "uniform_renewal_schedule",
     "poisson_schedule",

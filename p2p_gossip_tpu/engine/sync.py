@@ -264,10 +264,12 @@ def run_sync_sim(
             raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
         from p2p_gossip_tpu.utils import checkpoint as ckpt
 
+        # Fingerprint the *effective* staged delays (dg may have been passed
+        # in directly, overriding ell_delays/constant_delay).
         ckpt_fp = ckpt.fingerprint(
             "sync_sim", graph.n, graph.edges(), schedule.origins,
-            schedule.gen_ticks, horizon_ticks, chunk_size, ell_delays,
-            constant_delay,
+            schedule.gen_ticks, horizon_ticks, chunk_size,
+            np.asarray(dg.ell_delay), dg.uniform_delay, dg.ring_size,
         )
         loaded = ckpt.load_checkpoint(checkpoint_path)
         if loaded is not None:

@@ -49,10 +49,21 @@ def build_parser() -> argparse.ArgumentParser:
         help="Execution engine (default: tpu)",
     )
     p.add_argument(
-        "--topology", choices=("er", "ba", "ring"), default="er",
-        help="Topology family (er = reference's random topology)",
+        "--topology", choices=("er", "ba", "ring", "ws", "grid", "torus"),
+        default="er",
+        help="Topology family (er = reference's random topology; ws = "
+        "Watts-Strogatz small-world; grid/torus = 2D lattice)",
     )
     p.add_argument("--baM", type=int, default=3, help="Edges per node for --topology ba")
+    p.add_argument("--wsK", type=int, default=4, help="Lattice degree for --topology ws")
+    p.add_argument(
+        "--wsBeta", type=float, default=0.1,
+        help="Rewiring probability for --topology ws",
+    )
+    p.add_argument(
+        "--gridCols", type=int, default=0,
+        help="Columns for --topology grid/torus (default: ~sqrt(numNodes))",
+    )
     p.add_argument(
         "--protocol", choices=("push", "pushpull"), default="push",
         help="Gossip protocol: push flooding (reference) or push-pull "
@@ -121,6 +132,28 @@ def run(argv=None) -> int:
         g = topo.erdos_renyi(args.numNodes, args.connectionProb, seed=args.seed)
     elif args.topology == "ba":
         g = topo.barabasi_albert(args.numNodes, m=args.baM, seed=args.seed)
+    elif args.topology == "ws":
+        g = topo.watts_strogatz(
+            args.numNodes, k=args.wsK, beta=args.wsBeta, seed=args.seed
+        )
+    elif args.topology in ("grid", "torus"):
+        if args.gridCols:
+            cols = args.gridCols
+        else:
+            # Most-square factorization: first divisor at or below sqrt(n).
+            cols = next(
+                c for c in range(int(np.sqrt(args.numNodes)), 0, -1)
+                if args.numNodes % c == 0
+            )
+        rows = -(-args.numNodes // cols)
+        if rows * cols != args.numNodes:
+            print(
+                f"error: --numNodes {args.numNodes} is not rows*cols "
+                f"(cols={cols}); pass --gridCols",
+                file=sys.stderr,
+            )
+            return 2
+        g = topo.grid_graph(rows, cols, torus=args.topology == "torus")
     else:
         g = topo.ring_graph(args.numNodes)
 

@@ -8,7 +8,9 @@ from p2p_gossip_tpu.models.topology import (
     barabasi_albert,
     complete_graph,
     erdos_renyi,
+    grid_graph,
     ring_graph,
+    watts_strogatz,
 )
 
 
@@ -106,3 +108,69 @@ def test_edges_canonical():
     e = g.edges()
     assert (e[:, 0] < e[:, 1]).all()
     assert e.shape[0] == g.num_edges
+
+
+def test_ws_beta_zero_is_lattice():
+    g = watts_strogatz(20, k=4, beta=0.0, seed=1)
+    g.validate()
+    assert (g.degree == 4).all()
+    assert _connected(g)
+    # Every node links to its 1- and 2-hop ring neighbors.
+    for i in (0, 7, 19):
+        nbrs = set(g.indices[g.indptr[i] : g.indptr[i + 1]].tolist())
+        assert nbrs == {(i - 2) % 20, (i - 1) % 20, (i + 1) % 20, (i + 2) % 20}
+
+
+def test_ws_beta_one_rewires_most_edges():
+    n, k = 400, 4
+    g0 = watts_strogatz(n, k=k, beta=0.0, seed=2)
+    g1 = watts_strogatz(n, k=k, beta=1.0, seed=2)
+    g1.validate()
+    # Mean degree is conserved up to duplicate-collapse losses.
+    assert g1.num_edges > 0.9 * g0.num_edges
+    lattice = {tuple(e) for e in g0.edges().tolist()}
+    kept = sum(1 for e in g1.edges().tolist() if tuple(e) in lattice)
+    assert kept < 0.1 * g0.num_edges
+
+
+def test_ws_no_isolated_nodes_and_deterministic():
+    for seed in range(5):
+        g = watts_strogatz(101, k=2, beta=0.5, seed=seed)
+        g.validate()  # asserts min degree >= 1
+    a = watts_strogatz(64, k=4, beta=0.3, seed=9)
+    b = watts_strogatz(64, k=4, beta=0.3, seed=9)
+    assert np.array_equal(a.indices, b.indices)
+
+
+def test_ws_validates_params():
+    with pytest.raises(ValueError):
+        watts_strogatz(10, k=3)
+    with pytest.raises(ValueError):
+        watts_strogatz(4, k=4)
+    with pytest.raises(ValueError):
+        watts_strogatz(10, k=2, beta=1.5)
+
+
+def test_grid_structure():
+    g = grid_graph(3, 4)
+    g.validate()
+    assert g.n == 12
+    # Interior nodes have degree 4, corners 2, edges 3.
+    assert g.num_edges == 3 * 3 + 2 * 4  # rows*(cols-1) + (rows-1)*cols
+    assert sorted(g.degree.tolist()).count(2) == 4
+    assert _connected(g)
+
+
+def test_torus_is_regular():
+    g = grid_graph(4, 5, torus=True)
+    g.validate()
+    assert (g.degree == 4).all()
+    assert _connected(g)
+    # 3xC and Rx2 wrap edges must not duplicate existing lattice edges.
+    g2 = grid_graph(2, 4, torus=True)
+    g2.validate()
+
+
+def test_grid_validates_params():
+    with pytest.raises(ValueError):
+        grid_graph(1, 1)
