@@ -56,15 +56,27 @@ struct SeenSet {
 
 extern "C" {
 
+// Bump whenever any exported signature changes. runtime/native.py refuses a
+// library whose version doesn't match (a stale .so bound with the wrong
+// argument layout would corrupt memory) and falls back to the Python engine.
+int64_t gossip_abi_version() { return 2; }
+
 // Runs the event-driven simulation. Returns the number of events processed
 // (heap pops), the metric NS-3-style engines are measured by. Snapshot
 // arrays may be null when num_snapshots == 0; boundaries must be sorted
 // ascending, and each snapshot is taken the moment simulated time reaches
 // its tick (PrintPeriodicStats parity).
+//
+// Churn (models/churn.py semantics): churn_start/churn_end are (n x churn_k)
+// downtime intervals [start, end) — may be null when churn_k == 0. An event
+// at a down node is popped (counted in the return value, like the Python
+// engine) but has no effect: generations are skipped, arrivals are lost
+// without entering the seen-set.
 int64_t gossip_run_event_sim(
     int64_t n, const int64_t* indptr, const int32_t* indices,
     const int32_t* csr_delays, int64_t num_shares, const int32_t* origins,
     const int32_t* gen_ticks, int64_t horizon,
+    int64_t churn_k, const int32_t* churn_start, const int32_t* churn_end,
     int64_t num_snapshots, const int64_t* snapshot_ticks,
     int64_t* snap_generated, int64_t* snap_processed,
     int64_t* out_generated, int64_t* out_received, int64_t* out_sent) {
@@ -103,6 +115,15 @@ int64_t gossip_run_event_sim(
     }
   };
 
+  auto is_up = [&](int64_t node, int64_t t) {
+    for (int64_t j = 0; j < churn_k; ++j) {
+      const int64_t s = churn_start[node * churn_k + j];
+      const int64_t e = churn_end[node * churn_k + j];
+      if (s <= t && t < e) return false;
+    }
+    return true;
+  };
+
   while (!heap.empty()) {
     const auto [t, p] = heap.top();
     heap.pop();
@@ -110,6 +131,7 @@ int64_t gossip_run_event_sim(
     ++events;
     const int64_t node = (p >> 32) & 0x7fffffff;
     const int64_t share = static_cast<uint32_t>(p);
+    if (churn_k > 0 && !is_up(node, t)) continue;
     if (p & kGenFlag) {
       ++out_generated[node];
       ++total_generated;

@@ -43,11 +43,17 @@ def run_event_sim(
     constant_delay: int = 1,
     coverage_slots: int | None = None,
     snapshot_ticks: list[int] | None = None,
+    churn=None,
 ) -> NodeStats:
     """Run the event-driven gossip simulation for ``horizon_ticks`` ticks.
 
     ``ell_delays`` (aligned with ``graph.ell()``) gives per-edge integer
     delays; otherwise every edge takes ``constant_delay`` ticks.
+
+    ``churn`` is an optional `models.churn.ChurnModel`: a generation event
+    whose origin is down is skipped outright, and a message arriving at a
+    down node is lost (dropped, NOT marked seen — a later copy can still be
+    delivered). Identical counters to the sync engine under the same model.
 
     Returns per-node counters; if ``coverage_slots`` is set, also records each
     listed share's first-arrival tick per node in ``stats.extra``.
@@ -123,10 +129,24 @@ def run_event_sim(
     # silent run pays one compare per event.
     trace = log.enabled(p2plog.LOG_LOGIC)
 
+    if churn is not None:
+        c_start, c_end = churn.down_start, churn.down_end
+
+        def is_up(node: int, t: int) -> bool:
+            return not ((c_start[node] <= t) & (t < c_end[node])).any()
+
     while heap:
         t, _, kind, node, share = heapq.heappop(heap)
         take_snapshots(t)
         events_processed += 1
+        if churn is not None and not is_up(node, t):
+            if trace:
+                log.logic(
+                    f"Node {node} is down, "
+                    + ("generation skipped" if kind == 0 else "share lost"),
+                    sim_time=t,
+                )
+            continue
         if kind == 0:
             generated[node] += 1
             seen[node].add(share)

@@ -32,6 +32,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from p2p_gossip_tpu.models.churn import (
+    effective_generated,
+    to_device as churn_to_device,
+    up_mask_jnp,
+)
 from p2p_gossip_tpu.models.generation import Schedule
 from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
@@ -119,8 +124,16 @@ def apply_tick_updates(seen, arrivals, gen_bits, gen_cnt, received, sent, degree
     return seen, newly | gen_bits, received, sent
 
 
-def _tick_body(dg: DeviceGraph, block: int, state, origins, slots, gen_ticks):
-    """One synchronous tick. state = (t, seen, hist, received, sent)."""
+def _tick_body(
+    dg: DeviceGraph, block: int, state, origins, slots, gen_ticks, churn=None
+):
+    """One synchronous tick. state = (t, seen, hist, received, sent).
+
+    ``churn`` is an optional ``(down_start, down_end)`` pair of (N, K)
+    interval arrays (models/churn.py): a down node's arrivals are lost
+    (never enter ``seen``) and its generations are skipped, which zeroes
+    its forward/send contribution for the tick automatically.
+    """
     t, seen, hist, received, sent = state
     n, w = seen.shape
     if dg.uniform_delay is not None:
@@ -134,6 +147,10 @@ def _tick_body(dg: DeviceGraph, block: int, state, origins, slots, gen_ticks):
             ring_size=dg.ring_size, block=block,
         )
     gen_active = gen_ticks == t
+    if churn is not None:
+        up = up_mask_jnp(churn[0], churn[1], t)
+        arrivals = jnp.where(up[:, None], arrivals, jnp.uint32(0))
+        gen_active = gen_active & up[origins]
     gen_bits = bitmask.slot_scatter(n, w, origins, slots, gen_active)
     gen_cnt = (
         jnp.zeros((n,), dtype=jnp.int32)
@@ -156,6 +173,7 @@ def _run_chunk_while(
     gen_ticks: jnp.ndarray,  # (S,) int32 (>= horizon entries never fire)
     t_start: jnp.ndarray,    # scalar int32
     last_gen: jnp.ndarray,   # scalar int32
+    churn=None,              # optional ((N, K), (N, K)) downtime intervals
     *,
     chunk_size: int,
     horizon: int,
@@ -179,7 +197,7 @@ def _run_chunk_while(
         return (t < horizon) & (in_flight | pending)
 
     def body(state):
-        return _tick_body(dg, block, state, origins, slots, gen_ticks)
+        return _tick_body(dg, block, state, origins, slots, gen_ticks, churn)
 
     t, seen, hist, received, sent = jax.lax.while_loop(cond, body, state)
     return seen, received, sent
@@ -192,6 +210,7 @@ def _run_chunk_scan(
     dg: DeviceGraph,
     origins: jnp.ndarray,
     gen_ticks: jnp.ndarray,
+    churn=None,
     *,
     chunk_size: int,
     horizon: int,
@@ -212,7 +231,7 @@ def _run_chunk_scan(
     )
 
     def step(state, _):
-        state = _tick_body(dg, block, state, origins, slots, gen_ticks)
+        state = _tick_body(dg, block, state, origins, slots, gen_ticks, churn)
         if use_pallas:
             from p2p_gossip_tpu.ops.pallas_kernels import coverage_per_slot_pallas
 
@@ -238,6 +257,7 @@ def run_sync_sim(
     checkpoint_path: str | None = None,
     checkpoint_every: int = 1,
     stop_after_chunks: int | None = None,
+    churn=None,
 ) -> NodeStats:
     """Run the full simulation on the synchronous engine.
 
@@ -251,8 +271,13 @@ def run_sync_sim(
     see utils/checkpoint.py). ``stop_after_chunks`` ends the run early
     after that many chunks this call (simulating interruption; used by
     tests and incremental drivers).
+
+    ``churn`` is an optional `models.churn.ChurnModel`: nodes lose arrivals
+    and skip generations while inside a downtime interval (same semantics,
+    and identical counters, as the event engines run with the same model).
     """
     dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
+    churn_dev = churn_to_device(churn)
     chunk_size = min(chunk_size, max(32, schedule.num_shares))
     # Round chunk size up to whole words.
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
@@ -270,6 +295,8 @@ def run_sync_sim(
             "sync_sim", graph.n, graph.edges(), schedule.origins,
             schedule.gen_ticks, horizon_ticks, chunk_size,
             np.asarray(dg.ell_delay), dg.uniform_delay, dg.ring_size,
+            churn.down_start if churn is not None else None,
+            churn.down_end if churn is not None else None,
         )
         loaded = ckpt.load_checkpoint(checkpoint_path)
         if loaded is not None:
@@ -325,7 +352,7 @@ def run_sync_sim(
             last_gen = jnp.asarray(last_t, dtype=jnp.int32)
             _, r, s = _run_chunk_while(
                 dg, jnp.asarray(origins), jnp.asarray(gen_ticks), t_start,
-                last_gen,
+                last_gen, churn_dev,
                 chunk_size=chunk_size, horizon=horizon_ticks, block=block,
             )
             received += np.asarray(r, dtype=np.int64)
@@ -336,7 +363,7 @@ def run_sync_sim(
         ):
             save(ci + 1)
 
-    generated = schedule.generated_per_node(horizon_ticks).astype(np.int64)
+    generated = effective_generated(schedule, horizon_ticks, churn)
     degree = np.asarray(dg.degree, dtype=np.int64)
     # Generation itself also broadcasts (GossipShareToPeers, p2pnode.cc:123):
     # already folded into `sent` on-device via gen_cnt.
@@ -358,6 +385,7 @@ def run_flood_coverage(
     constant_delay: int = 1,
     block: int = DEFAULT_DEGREE_BLOCK,
     device_graph: DeviceGraph | None = None,
+    churn=None,
 ):
     """Flood coverage-time experiment: one share per origin, all at t=0.
 
@@ -374,12 +402,13 @@ def run_flood_coverage(
     # Gate on where the graph actually lives (tests pin data to host CPU
     # even though a TPU plugin is registered).
     use_pallas = any(d.platform == "tpu" for d in dg.ell_idx.devices())
+    churn_dev = churn_to_device(churn)
     _, r, snt, cov = _run_chunk_scan(
-        dg, jnp.asarray(o), jnp.asarray(g),
+        dg, jnp.asarray(o), jnp.asarray(g), churn_dev,
         chunk_size=chunk_size, horizon=horizon_ticks, block=block,
         use_pallas=use_pallas,
     )
-    generated = sched.generated_per_node(horizon_ticks).astype(np.int64)
+    generated = effective_generated(sched, horizon_ticks, churn)
     received = np.asarray(r, dtype=np.int64)
     stats = NodeStats(
         generated=generated,

@@ -1,0 +1,166 @@
+"""Node churn / failure model.
+
+The reference runs with permanently-up nodes (NS-3 apps started once at
+t=1.0, p2pnetwork.cc:193-219); real P2P networks lose and regain peers
+constantly. This module adds the standard availability model on top of any
+engine: each node carries up to K **downtime intervals** ``[start, end)`` in
+integer ticks. While down, a node
+
+- does not generate (its scheduled generation events are skipped outright —
+  no counter, no broadcast);
+- does not receive (messages arriving while it is down are lost: dropped
+  with no counter change and NOT inserted into the seen-set, so a later
+  copy of the same share via a slower path can still be delivered);
+- consequently does not forward or send.
+
+State is preserved across an outage (offline model, not crash-reset): the
+node keeps its seen-set and counters and resumes where it left off.
+
+The interval representation is chosen for the TPU engine: the per-tick up
+mask is ``~any(down_start <= t < down_end, axis=K)`` — a static-shape
+(N, K) compare with no per-tick host data, evaluated inside the jitted tick
+body. The event engines check the same intervals per event, which is what
+makes churn parity (identical counters across engines) testable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+try:  # jnp only needed by the TPU engines; keep the model importable anywhere.
+    import jax.numpy as jnp
+except ImportError:  # pragma: no cover
+    jnp = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ChurnModel:
+    """Per-node downtime intervals, padded to a common K with empty
+    (start == end == 0) slots. Overlapping intervals are allowed; the node
+    is down in their union."""
+
+    n: int
+    down_start: np.ndarray  # (N, K) int32
+    down_end: np.ndarray    # (N, K) int32; slot inactive when end <= start
+
+    def __post_init__(self):
+        ds = np.ascontiguousarray(self.down_start, dtype=np.int32)
+        de = np.ascontiguousarray(self.down_end, dtype=np.int32)
+        if ds.shape != de.shape or ds.ndim != 2 or ds.shape[0] != self.n:
+            raise ValueError(
+                f"interval arrays must both be (n={self.n}, K); got "
+                f"{ds.shape} and {de.shape}"
+            )
+        object.__setattr__(self, "down_start", ds)
+        object.__setattr__(self, "down_end", de)
+
+    @property
+    def k(self) -> int:
+        return int(self.down_start.shape[1])
+
+    def up_at(self, nodes, ticks) -> np.ndarray:
+        """Vectorized availability check: are ``nodes`` up at ``ticks``?
+        Broadcasts like numpy; used by the event engines and by
+        `effective_schedule`."""
+        nodes = np.asarray(nodes)
+        t = np.asarray(ticks)[..., None]
+        ds = self.down_start[nodes]
+        de = self.down_end[nodes]
+        return ~np.any((ds <= t) & (t < de), axis=-1)
+
+    def up_mask(self, tick: int) -> np.ndarray:
+        """(N,) bool: which nodes are up at ``tick``."""
+        return self.up_at(np.arange(self.n), tick)
+
+    def total_downtime(self, horizon: int) -> np.ndarray:
+        """(N,) int64 ticks spent down within [0, horizon) — interval unions,
+        counted exactly (used by reports and tests)."""
+        out = np.zeros(self.n, dtype=np.int64)
+        for i in range(self.n):
+            ivs = [
+                (max(0, int(s)), min(horizon, int(e)))
+                for s, e in zip(self.down_start[i], self.down_end[i])
+                if e > s and e > 0 and s < horizon
+            ]
+            ivs.sort()
+            last_end = 0
+            for s, e in ivs:
+                s = max(s, last_end)
+                if e > s:
+                    out[i] += e - s
+                    last_end = e
+                last_end = max(last_end, e)
+        return out
+
+
+def always_up(n: int) -> ChurnModel:
+    """The no-churn identity (every interval slot empty)."""
+    z = np.zeros((n, 1), dtype=np.int32)
+    return ChurnModel(n=n, down_start=z, down_end=z.copy())
+
+
+def from_intervals(n: int, intervals) -> ChurnModel:
+    """Build from an explicit list of ``(node, down_start, down_end)``."""
+    per_node: list[list[tuple[int, int]]] = [[] for _ in range(n)]
+    for node, s, e in intervals:
+        if not 0 <= node < n:
+            raise ValueError(f"node {node} out of range [0, {n})")
+        if e > s:
+            per_node[node].append((int(s), int(e)))
+    k = max((len(v) for v in per_node), default=0) or 1
+    ds = np.zeros((n, k), dtype=np.int32)
+    de = np.zeros((n, k), dtype=np.int32)
+    for i, ivs in enumerate(per_node):
+        for j, (s, e) in enumerate(ivs):
+            ds[i, j] = s
+            de[i, j] = e
+    return ChurnModel(n=n, down_start=ds, down_end=de)
+
+
+def random_churn(
+    n: int,
+    horizon: int,
+    outage_prob: float = 0.1,
+    mean_down_ticks: float = 10.0,
+    max_outages: int = 1,
+    seed: int = 0,
+) -> ChurnModel:
+    """Seeded random outage schedule: each of ``max_outages`` slots per node
+    fails independently with probability ``outage_prob``, starting
+    U{0, horizon-1} and lasting 1 + Geometric ticks with the given mean."""
+    if not 0.0 <= outage_prob <= 1.0:
+        raise ValueError(f"outage_prob must be in [0, 1], got {outage_prob}")
+    k = max(1, int(max_outages))
+    rng = np.random.default_rng(seed)
+    active = rng.random((n, k)) < outage_prob
+    start = rng.integers(0, max(horizon, 1), size=(n, k))
+    dur = rng.geometric(min(1.0, 1.0 / max(mean_down_ticks, 1.0)), size=(n, k))
+    ds = np.where(active, start, 0).astype(np.int32)
+    de = np.where(active, np.minimum(start + dur, horizon), 0).astype(np.int32)
+    return ChurnModel(n=n, down_start=ds, down_end=de)
+
+
+def to_device(churn: "ChurnModel | None"):
+    """The interval pair as device arrays for the jitted tick bodies
+    (None passes through — the engines treat it as churn-off)."""
+    if churn is None:
+        return None
+    return (jnp.asarray(churn.down_start), jnp.asarray(churn.down_end))
+
+
+def up_mask_jnp(down_start, down_end, t):
+    """(N,) bool up mask inside a jitted tick body (t is a traced scalar)."""
+    return ~jnp.any((down_start <= t) & (t < down_end), axis=1)
+
+
+def effective_generated(schedule, horizon: int, churn: ChurnModel | None):
+    """Per-node sharesGenerated under churn: a share whose origin is down at
+    its generation tick is never generated (matches every engine's skip)."""
+    live = schedule.gen_ticks < horizon
+    if churn is not None:
+        live = live & churn.up_at(schedule.origins, schedule.gen_ticks)
+    return np.bincount(
+        schedule.origins[live], minlength=schedule.n_nodes
+    ).astype(np.int64)

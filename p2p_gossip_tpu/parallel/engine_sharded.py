@@ -27,6 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from p2p_gossip_tpu.engine.sync import apply_tick_updates
+from p2p_gossip_tpu.models.churn import effective_generated, up_mask_jnp
 from p2p_gossip_tpu.models.generation import Schedule
 from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
@@ -80,12 +81,14 @@ def build_sharded_runner(
     w = bitmask.num_words(chunk_size)
 
     def pass_fn(
-        ell_idx, ell_delay, ell_mask, degree, origins, gen_ticks,
-        t_start, last_gen,
+        ell_idx, ell_delay, ell_mask, degree, churn_start, churn_end,
+        origins, gen_ticks, t_start, last_gen,
     ):
-        # Local shapes: ell_* (n_loc, dmax); origins/gen_ticks (chunk_size,);
-        # t_start/last_gen scalars (min/max over ALL slices, so loop trip
-        # counts agree across devices).
+        # Local shapes: ell_* (n_loc, dmax); churn_* (n_loc, K) downtime
+        # intervals ((n_loc, 1) zeros when churn is off — the compare is
+        # vacuously up); origins/gen_ticks (chunk_size,); t_start/last_gen
+        # scalars (min/max over ALL slices, so loop trip counts agree across
+        # devices).
         row_offset = lax.axis_index(NODES_AXIS).astype(jnp.int32) * n_loc
         slots = jnp.arange(chunk_size, dtype=jnp.int32)
 
@@ -119,11 +122,16 @@ def build_sharded_runner(
                     hist, t, ell_idx, ell_delay, ell_mask,
                     ring_size=ring_size, block=block,
                 )
+            up = up_mask_jnp(churn_start, churn_end, t)
+            arrivals = jnp.where(up[:, None], arrivals, jnp.uint32(0))
             local_rows = origins - row_offset
             # Negative indices wrap under .at[] before mode="drop" applies,
             # so shares owned by other row shards must be masked explicitly.
+            in_shard = (local_rows >= 0) & (local_rows < n_loc)
             gen_active = (
-                (gen_ticks == t) & (local_rows >= 0) & (local_rows < n_loc)
+                (gen_ticks == t)
+                & in_shard
+                & up[jnp.clip(local_rows, 0, n_loc - 1)]
             )
             gen_bits = bitmask.slot_scatter(n_loc, w, local_rows, slots, gen_active)
             gen_cnt = (
@@ -153,6 +161,8 @@ def build_sharded_runner(
             P(NODES_AXIS, None),  # ell_delay
             P(NODES_AXIS, None),  # ell_mask
             P(NODES_AXIS),        # degree
+            P(NODES_AXIS, None),  # churn_start
+            P(NODES_AXIS, None),  # churn_end
             P(SHARES_AXIS),       # origins
             P(SHARES_AXIS),       # gen_ticks
             P(),                  # t_start
@@ -173,15 +183,23 @@ def run_sharded_sim(
     constant_delay: int = 1,
     chunk_size: int = 256,
     block: int = DEFAULT_DEGREE_BLOCK,
+    churn=None,
 ) -> NodeStats:
     """Drop-in counterpart of run_sync_sim/run_event_sim on a device mesh:
-    identical per-node counters, any number of shares."""
+    identical per-node counters, any number of shares — including under a
+    `models.churn.ChurnModel` (intervals shard with their node rows)."""
     n_node_shards = mesh.shape[NODES_AXIS]
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
     ell_idx, ell_delay, ell_mask, degree, ring, uniform = _padded_device_graph(
         graph, ell_delays, constant_delay, n_node_shards
     )
     n_padded = ell_idx.shape[0]
+    if churn is not None:
+        churn_start = pad_to_multiple(churn.down_start, n_node_shards)
+        churn_end = pad_to_multiple(churn.down_end, n_node_shards)
+    else:
+        churn_start = np.zeros((n_padded, 1), dtype=np.int32)
+        churn_end = np.zeros((n_padded, 1), dtype=np.int32)
     runner, pass_size = build_sharded_runner(
         mesh, n_padded, ring, chunk_size, horizon_ticks, block, uniform
     )
@@ -196,15 +214,15 @@ def run_sharded_sim(
         t_start = np.int32(chunk.gen_ticks[live].min())
         last_gen = np.int32(chunk.gen_ticks[live].max())
         r, s = runner(
-            ell_idx, ell_delay, ell_mask, degree, origins, gen_ticks,
-            t_start, last_gen,
+            ell_idx, ell_delay, ell_mask, degree, churn_start, churn_end,
+            origins, gen_ticks, t_start, last_gen,
         )
         received += np.asarray(r, dtype=np.int64)
         sent += np.asarray(s, dtype=np.int64)
 
     received = received[: graph.n]
     sent = sent[: graph.n]
-    generated = schedule.generated_per_node(horizon_ticks).astype(np.int64)
+    generated = effective_generated(schedule, horizon_ticks, churn)
     return NodeStats(
         generated=generated,
         received=received,

@@ -28,6 +28,11 @@ _LIB_PATHS = [
 _lib = None
 _lib_checked = False
 
+# Must match gossip_abi_version() in native/gossip_native.cc. Binding a stale
+# .so with a different argument layout would scribble over the wrong buffers,
+# so a mismatch is treated as "not built".
+ABI_VERSION = 2
+
 
 def load_library():
     """Load and memoize the native library; None if unavailable."""
@@ -42,6 +47,16 @@ def load_library():
                 lib = ctypes.CDLL(path)
             except OSError as e:  # built for wrong arch etc.
                 warnings.warn(f"failed to load {path}: {e}")
+                continue
+            try:
+                version = int(lib.gossip_abi_version())
+            except AttributeError:
+                version = 1
+            if version != ABI_VERSION:
+                warnings.warn(
+                    f"{path} has ABI version {version}, expected "
+                    f"{ABI_VERSION}; rebuild with `make -C native`"
+                )
                 continue
             _configure(lib)
             _lib = lib
@@ -62,6 +77,8 @@ def _configure(lib) -> None:
         i32p,                        # origins
         i32p,                        # gen_ticks
         ctypes.c_int64,              # horizon
+        ctypes.c_int64,              # churn_k
+        i32p, i32p,                  # churn_start, churn_end (n x churn_k)
         ctypes.c_int64,              # num_snapshots
         i64p, i64p, i64p,            # snapshot_ticks, snap_generated, snap_processed
         i64p, i64p, i64p,            # out: generated, received, sent
@@ -91,9 +108,11 @@ def run_native_sim(
     ell_delays: np.ndarray | None = None,
     constant_delay: int = 1,
     snapshot_ticks: list[int] | None = None,
+    churn=None,
 ) -> NodeStats:
     """Event-driven simulation on the C++ engine (counters identical to
-    `engine.event.run_event_sim`). Falls back to Python when unbuilt."""
+    `engine.event.run_event_sim`, including under a churn model). Falls back
+    to Python when unbuilt."""
     lib = load_library()
     if lib is None:
         warnings.warn(
@@ -103,7 +122,7 @@ def run_native_sim(
 
         return run_event_sim(
             graph, schedule, horizon_ticks, ell_delays, constant_delay,
-            snapshot_ticks=snapshot_ticks,
+            snapshot_ticks=snapshot_ticks, churn=churn,
         )
 
     n = graph.n
@@ -121,6 +140,17 @@ def run_native_sim(
     boundaries = np.asarray(sorted(snapshot_ticks or []), dtype=np.int64)
     snap_gen = np.zeros(max(len(boundaries), 1), dtype=np.int64)
     snap_proc = np.zeros(max(len(boundaries), 1), dtype=np.int64)
+    if churn is not None:
+        if churn.n != n:
+            raise ValueError(
+                f"churn model is for {churn.n} nodes, graph has {n}"
+            )
+        churn_k = churn.k
+        churn_start = np.ascontiguousarray(churn.down_start, dtype=np.int32)
+        churn_end = np.ascontiguousarray(churn.down_end, dtype=np.int32)
+    else:
+        churn_k = 0
+        churn_start = churn_end = np.zeros(1, dtype=np.int32)
     events = lib.gossip_run_event_sim(
         n,
         np.ascontiguousarray(graph.indptr, dtype=np.int64),
@@ -130,6 +160,9 @@ def run_native_sim(
         origins,
         gen_ticks,
         horizon_ticks,
+        churn_k,
+        churn_start,
+        churn_end,
         len(boundaries),
         np.ascontiguousarray(boundaries) if len(boundaries) else snap_gen,
         snap_gen,

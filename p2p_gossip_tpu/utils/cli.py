@@ -83,6 +83,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--delaySigma", type=float, default=0.5)
     p.add_argument("--delayMaxTicks", type=int, default=8)
     p.add_argument(
+        "--churnProb", type=float, default=0.0,
+        help="Node churn: probability each node suffers a random outage "
+        "(per outage slot; 0 disables churn). Down nodes lose arriving "
+        "shares and skip generations.",
+    )
+    p.add_argument(
+        "--churnDowntime", type=float, default=5.0,
+        help="Mean outage duration in seconds (geometric, min one tick)",
+    )
+    p.add_argument(
+        "--churnOutages", type=int, default=1,
+        help="Maximum outages per node over the run",
+    )
+    p.add_argument(
         "--statsInterval", type=float, default=10.0,
         help="Periodic stats interval in seconds (event/native backends)",
     )
@@ -173,12 +187,38 @@ def run(argv=None) -> int:
             seed=args.seed,
         )
 
+    churn = None
+    if not 0.0 <= args.churnProb <= 1.0:
+        print(
+            f"error: --churnProb must be in [0, 1], got {args.churnProb:g}",
+            file=sys.stderr,
+        )
+        return 2
+    if args.churnProb > 0.0:
+        from p2p_gossip_tpu.models.churn import random_churn
+
+        # Offset seed so the churn stream is independent of the topology and
+        # schedule streams seeded with args.seed.
+        churn = random_churn(
+            g.n, horizon,
+            outage_prob=args.churnProb,
+            mean_down_ticks=max(args.churnDowntime / tick_dt, 1.0),
+            max_outages=args.churnOutages,
+            seed=args.seed + 7919,
+        )
+
     print(
         f"Starting gossip network simulation: {g.n} nodes, "
         f"{g.num_edges} links, {sched.num_shares} shares scheduled, "
         f"{horizon} ticks ({args.simTime:g}s at {args.Latency:g}ms), "
         f"backend={args.backend}"
     )
+    if churn is not None:
+        n_outages = int((churn.down_end > churn.down_start).sum())
+        print(
+            f"Churn enabled: {n_outages} outages scheduled across {g.n} "
+            f"nodes (mean downtime {args.churnDowntime:g}s)"
+        )
     interval_ticks = int(round(args.statsInterval / tick_dt))
     snapshot_ticks = (
         list(range(interval_ticks, horizon, interval_ticks))
@@ -188,6 +228,9 @@ def run(argv=None) -> int:
 
     if args.protocol == "pushpull" and args.backend != "tpu":
         print("error: --protocol pushpull requires --backend tpu", file=sys.stderr)
+        return 2
+    if churn is not None and args.protocol != "push":
+        print("error: --churnProb requires --protocol push", file=sys.stderr)
         return 2
     if args.checkpoint and (args.backend != "tpu" or args.protocol != "push"):
         print(
@@ -214,18 +257,21 @@ def run(argv=None) -> int:
             g, sched, horizon, ell_delays=delays, chunk_size=args.chunkSize,
             checkpoint_path=args.checkpoint or None,
             checkpoint_every=args.checkpointEvery,
+            churn=churn,
         )
     elif args.backend == "native":
         from p2p_gossip_tpu.runtime.native import run_native_sim
 
         stats = run_native_sim(
-            g, sched, horizon, ell_delays=delays, snapshot_ticks=snapshot_ticks
+            g, sched, horizon, ell_delays=delays, snapshot_ticks=snapshot_ticks,
+            churn=churn,
         )
     else:
         from p2p_gossip_tpu.engine.event import run_event_sim
 
         stats = run_event_sim(
-            g, sched, horizon, ell_delays=delays, snapshot_ticks=snapshot_ticks
+            g, sched, horizon, ell_delays=delays, snapshot_ticks=snapshot_ticks,
+            churn=churn,
         )
     wall = time.perf_counter() - t0
 
