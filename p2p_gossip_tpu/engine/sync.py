@@ -42,8 +42,10 @@ from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
 from p2p_gossip_tpu.ops.ell import (
     DEFAULT_DEGREE_BLOCK,
+    build_degree_buckets,
     detect_uniform_delay,
     propagate,
+    propagate_bucketed,
     propagate_uniform,
 )
 from p2p_gossip_tpu.utils import logging as p2plog
@@ -65,22 +67,44 @@ class DeviceGraph:
     degree: jnp.ndarray     # (N,) int32
     ring_size: int          # D = max delay + 1
     uniform_delay: int | None = None  # set when every edge has this delay
+    buckets: tuple | None = None  # degree-bucketed ELL (ops/ell.py)
 
     @staticmethod
     def build(
         graph: Graph,
         ell_delays: np.ndarray | None = None,
         constant_delay: int = 1,
+        *,
+        bucketed: bool | None = None,
+        block: int = DEFAULT_DEGREE_BLOCK,
     ) -> "DeviceGraph":
+        """``bucketed=None`` (default) enables degree-bucketed ELL staging
+        for large graphs — identical results, ~30% less gather traffic on
+        heavy-tailed degree distributions (see `ops.ell.build_degree_buckets`).
+        """
+        if bucketed is None:
+            bucketed = graph.n >= 4096
         ell_idx, ell_mask = graph.ell()
         if ell_delays is None:
             ell_delays = np.full(ell_idx.shape, constant_delay, dtype=np.int32)
         dmax_delay = int(ell_delays.max()) if ell_delays.size else 1
         uniform = detect_uniform_delay(ell_delays, ell_mask)
-        if uniform is not None:
+        placeholder = np.ones((1, 1), dtype=np.int32)
+        buckets = None
+        if bucketed:
+            buckets = build_degree_buckets(
+                graph,
+                None if uniform is not None else ell_delays,
+                block=block,
+                ell=(ell_idx, ell_mask),
+            )
+            # The bucketed path never reads the full-width arrays.
+            ell_idx = ell_delays = placeholder
+            ell_mask = placeholder.astype(bool)
+        elif uniform is not None:
             # The fast path never reads per-edge delays: stage a placeholder
             # instead of an (N, dmax) array of dead HBM.
-            ell_delays = np.ones((1, 1), dtype=np.int32)
+            ell_delays = placeholder
         return DeviceGraph(
             n=graph.n,
             ell_idx=jnp.asarray(ell_idx, dtype=jnp.int32),
@@ -89,21 +113,42 @@ class DeviceGraph:
             degree=jnp.asarray(graph.degree, dtype=jnp.int32),
             ring_size=dmax_delay + 1,
             uniform_delay=uniform,
+            buckets=buckets,
         )
 
 
-# Pytree registration: arrays are leaves; (n, ring_size, uniform_delay) ride
-# along as static aux data — so a DeviceGraph passes straight through
-# jit/shard_map and path selection on uniform_delay stays trace-time.
+def _canonical_delays(dg: DeviceGraph) -> np.ndarray:
+    """Per-edge delays in CSR order, independent of how they were staged —
+    bucketed and full-width stagings of the same logical delays fingerprint
+    identically (resume must survive a staging-layout change)."""
+    if dg.uniform_delay is not None:
+        return np.asarray([dg.uniform_delay], dtype=np.int64)
+    if dg.buckets is None:
+        mask = np.asarray(dg.ell_mask)
+        return np.asarray(dg.ell_delay)[mask]
+    per_node: list = [None] * dg.n
+    for rows, _idx, b_mask, b_delay in dg.buckets:
+        rows_np = np.asarray(rows)
+        mask_np = np.asarray(b_mask)
+        delay_np = np.asarray(b_delay)
+        for j, r in enumerate(rows_np):
+            per_node[r] = delay_np[j][mask_np[j]]
+    return np.concatenate(per_node)
+
+
+# Pytree registration: arrays (including the nested bucket tuples) are
+# leaves; (n, ring_size, uniform_delay) ride along as static aux data — so a
+# DeviceGraph passes straight through jit/shard_map and path selection on
+# uniform_delay/buckets stays trace-time.
 jax.tree_util.register_pytree_node(
     DeviceGraph,
     lambda dg: (
-        (dg.ell_idx, dg.ell_delay, dg.ell_mask, dg.degree),
+        (dg.ell_idx, dg.ell_delay, dg.ell_mask, dg.degree, dg.buckets),
         (dg.n, dg.ring_size, dg.uniform_delay),
     ),
     lambda aux, ch: DeviceGraph(
         n=aux[0], ell_idx=ch[0], ell_delay=ch[1], ell_mask=ch[2],
-        degree=ch[3], ring_size=aux[1], uniform_delay=aux[2],
+        degree=ch[3], ring_size=aux[1], uniform_delay=aux[2], buckets=ch[4],
     ),
 )
 
@@ -136,7 +181,12 @@ def _tick_body(
     """
     t, seen, hist, received, sent = state
     n, w = seen.shape
-    if dg.uniform_delay is not None:
+    if dg.buckets is not None:
+        arrivals = propagate_bucketed(
+            hist, t, dg.buckets, n_out=n,
+            ring_size=dg.ring_size, uniform_delay=dg.uniform_delay, block=block,
+        )
+    elif dg.uniform_delay is not None:
         arrivals = propagate_uniform(
             hist, t, dg.ell_idx, dg.ell_mask,
             ring_size=dg.ring_size, uniform_delay=dg.uniform_delay, block=block,
@@ -289,12 +339,13 @@ def run_sync_sim(
             raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
         from p2p_gossip_tpu.utils import checkpoint as ckpt
 
-        # Fingerprint the *effective* staged delays (dg may have been passed
-        # in directly, overriding ell_delays/constant_delay).
+        # Fingerprint the *effective* delays (dg may have been passed in
+        # directly, overriding ell_delays/constant_delay) in canonical CSR
+        # order, so the fingerprint doesn't depend on staging layout.
         ckpt_fp = ckpt.fingerprint(
             "sync_sim", graph.n, graph.edges(), schedule.origins,
             schedule.gen_ticks, horizon_ticks, chunk_size,
-            np.asarray(dg.ell_delay), dg.uniform_delay, dg.ring_size,
+            _canonical_delays(dg), dg.uniform_delay, dg.ring_size,
             churn.down_start if churn is not None else None,
             churn.down_end if churn is not None else None,
         )

@@ -145,7 +145,16 @@ def run_pushpull_sim(
     used by the tests to compare against a numpy oracle with identical
     randomness. Returns (stats, coverage or None).
     """
-    dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
+    # Partner selection indexes the full-width ELL directly, so bucketed
+    # staging (which replaces it with a placeholder) is not usable here.
+    dg = device_graph or DeviceGraph.build(
+        graph, ell_delays, constant_delay, bucketed=False
+    )
+    if dg.buckets is not None:
+        raise ValueError(
+            "push-pull requires a DeviceGraph built with bucketed=False "
+            "(random partner selection reads the full ELL)"
+        )
     chunk_size = min(chunk_size, max(32, schedule.num_shares))
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
     override = (

@@ -136,6 +136,107 @@ def propagate_uniform(
     return arrivals
 
 
+def build_degree_buckets(
+    graph,
+    ell_delays=None,
+    *,
+    block: int = DEFAULT_DEGREE_BLOCK,
+    min_rows: int = 2048,
+    ell: tuple | None = None,
+):
+    """Group nodes into degree buckets for padding-free ELL propagation.
+
+    The single full-width ELL pads every row to the global max degree; on a
+    100K-node p=0.001 ER graph that is ~45% wasted gather traffic (mean
+    degree ~100, dmax ~145 — and the gather is the whole tick cost). Here
+    nodes are grouped by ``ceil(degree / block)`` so each group's ELL is
+    padded only to its own cap; groups smaller than ``min_rows`` are merged
+    upward (into the next cap) so tiny graphs collapse back to one bucket.
+
+    Returns a tuple of ``(rows, ell_idx, ell_mask, ell_delay)`` per bucket
+    (``ell_delay`` is None when ``ell_delays`` is None); the ``rows`` arrays
+    partition ``range(n)``. A nice side effect: rows within a bucket have
+    near-equal degree, so the k-th sorted neighbor of each row sits near the
+    same quantile of the id space — the per-slot gather touches a narrow
+    band of source rows, which measurably improves gather locality.
+
+    ``ell`` lets the caller pass an already-materialized ``(ell_idx,
+    ell_mask)`` pair so the (N, dmax) arrays aren't rebuilt from CSR.
+    """
+    import numpy as np
+
+    deg = np.asarray(graph.degree)
+    ell_idx, ell_mask = ell if ell is not None else graph.ell()
+    level = (deg + block - 1) // block  # cap = level * block
+    order = np.argsort(level, kind="stable")
+    sorted_level = level[order]
+    # Split points where the level changes.
+    change = np.flatnonzero(np.diff(sorted_level)) + 1
+    groups = np.split(order, change)
+    # Merge small groups upward (next group has a >= cap, so padding stays valid).
+    merged: list[np.ndarray] = []
+    pending: list[np.ndarray] = []
+    pending_count = 0
+    for g in groups:
+        pending.append(g)
+        pending_count += g.shape[0]
+        if pending_count >= min_rows:
+            merged.append(np.concatenate(pending))
+            pending, pending_count = [], 0
+    if pending:
+        # Leftovers keep their own bucket: folding a high-degree tail into
+        # the previous bucket would raise that bucket's cap for every row.
+        merged.append(np.concatenate(pending))
+    buckets = []
+    for rows in merged:
+        cap = int(level[rows].max()) * block
+        cap = max(cap, block)
+        buckets.append(
+            (
+                jnp.asarray(rows.astype(np.int32)),
+                jnp.asarray(np.ascontiguousarray(ell_idx[rows, :cap])),
+                jnp.asarray(np.ascontiguousarray(ell_mask[rows, :cap])),
+                jnp.asarray(np.ascontiguousarray(ell_delays[rows, :cap]))
+                if ell_delays is not None
+                else None,
+            )
+        )
+    return tuple(buckets)
+
+
+def propagate_bucketed(
+    hist: jnp.ndarray,
+    tick: jnp.ndarray,
+    buckets,
+    *,
+    n_out: int,
+    ring_size: int,
+    uniform_delay: int | None = None,
+    block: int = DEFAULT_DEGREE_BLOCK,
+) -> jnp.ndarray:
+    """Gather-OR over degree buckets (see `build_degree_buckets`).
+
+    Bitwise-identical to `propagate`/`propagate_uniform` on the full ELL —
+    each bucket computes its rows' arrivals over its own (tight) ELL and the
+    results are scattered back into node order.
+    """
+    w = hist.shape[-1]
+    arrivals = jnp.zeros((n_out, w), dtype=jnp.uint32)
+    for rows, b_idx, b_mask, b_delay in buckets:
+        if uniform_delay is not None:
+            part = propagate_uniform(
+                hist, tick, b_idx, b_mask,
+                ring_size=ring_size, uniform_delay=uniform_delay, block=block,
+            )
+        else:
+            part = propagate(
+                hist, tick, b_idx, b_delay, b_mask,
+                ring_size=ring_size, block=block,
+            )
+        arrivals = arrivals.at[rows].set(part, mode="drop")
+    return arrivals
+
+
 def propagate_reference(hist, tick, ell_idx, ell_delay, ell_mask, *, ring_size):
     """Straight-line jnp version (materializes (N_out, dmax, W)) — oracle for
     tests and for the Pallas kernel."""

@@ -34,12 +34,52 @@ _lib_checked = False
 ABI_VERSION = 2
 
 
+def _try_autobuild() -> None:
+    """Build the library if a toolchain is available (fresh checkouts don't
+    ship the .so). The build targets a process-private name and is
+    os.replace()d into place, so concurrent processes racing on a fresh
+    checkout each install a complete library atomically. Failures are
+    silent — the caller falls back to the Python event engine either way."""
+    import subprocess
+
+    makedir = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "..", "native")
+    )
+    if not os.path.exists(os.path.join(makedir, "Makefile")):
+        return
+    tmp_name = f".libgossip_native.{os.getpid()}.so"
+    tmp_path = os.path.join(makedir, tmp_name)
+    try:
+        proc = subprocess.run(
+            ["make", "-C", makedir, f"OUT={tmp_name}", tmp_name],
+            capture_output=True,
+            timeout=120,
+            check=False,
+        )
+        if proc.returncode == 0 and os.path.exists(tmp_path):
+            os.replace(tmp_path, os.path.join(makedir, "libgossip_native.so"))
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    finally:
+        if os.path.exists(tmp_path):
+            try:
+                os.remove(tmp_path)
+            except OSError:
+                pass
+
+
 def load_library():
-    """Load and memoize the native library; None if unavailable."""
+    """Load and memoize the native library; None if unavailable.
+
+    If the .so is missing, one `make -C native` is attempted automatically
+    before giving up.
+    """
     global _lib, _lib_checked
     if _lib_checked:
         return _lib
     _lib_checked = True
+    if not any(os.path.exists(os.path.abspath(p)) for p in _LIB_PATHS):
+        _try_autobuild()
     for path in _LIB_PATHS:
         path = os.path.abspath(path)
         if os.path.exists(path):

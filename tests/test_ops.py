@@ -160,3 +160,65 @@ def test_delay_symmetry():
                 lut[(i, j)] = int(delays[i, k])
     for (i, j), d in lut.items():
         assert lut[(j, i)] == d
+
+
+def test_bucketed_propagate_equals_reference():
+    from p2p_gossip_tpu.ops.ell import build_degree_buckets, propagate_bucketed
+
+    # Heavy-tailed degrees so multiple buckets actually form.
+    from p2p_gossip_tpu.models.topology import barabasi_albert
+
+    g = barabasi_albert(120, m=3, seed=6)
+    ell_idx, ell_mask = g.ell()
+    delays = lognormal_delays(g, mean_ticks=2.0, sigma=0.8, max_ticks=4, seed=2)
+    ring = 5
+    rng = np.random.default_rng(11)
+    hist = jnp.asarray(
+        rng.integers(0, 2**32, size=(ring, g.n, 3), dtype=np.uint64).astype(np.uint32)
+    )
+    buckets = build_degree_buckets(g, delays, block=4, min_rows=8)
+    assert len(buckets) > 1
+    # The bucket row sets partition range(n).
+    all_rows = np.sort(np.concatenate([np.asarray(b[0]) for b in buckets]))
+    np.testing.assert_array_equal(all_rows, np.arange(g.n))
+    for t in (0, 3, 11):
+        got = np.asarray(
+            propagate_bucketed(
+                hist, jnp.int32(t), buckets, n_out=g.n, ring_size=ring, block=4
+            )
+        )
+        want = np.asarray(
+            propagate_reference(
+                hist, jnp.int32(t), jnp.asarray(ell_idx), jnp.asarray(delays),
+                jnp.asarray(ell_mask), ring_size=ring,
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+def test_bucketed_propagate_uniform_delay():
+    from p2p_gossip_tpu.ops.ell import build_degree_buckets, propagate_bucketed
+
+    g = erdos_renyi(90, 0.08, seed=8)
+    ell_idx, ell_mask = g.ell()
+    delays = constant_delays(g, 2)
+    ring = 3
+    rng = np.random.default_rng(13)
+    hist = jnp.asarray(
+        rng.integers(0, 2**32, size=(ring, g.n, 2), dtype=np.uint64).astype(np.uint32)
+    )
+    buckets = build_degree_buckets(g, None, block=4, min_rows=8)
+    for t in (0, 2, 7):
+        got = np.asarray(
+            propagate_bucketed(
+                hist, jnp.int32(t), buckets, n_out=g.n, ring_size=ring,
+                uniform_delay=2, block=4,
+            )
+        )
+        want = np.asarray(
+            propagate_reference(
+                hist, jnp.int32(t), jnp.asarray(ell_idx), jnp.asarray(delays),
+                jnp.asarray(ell_mask), ring_size=ring,
+            )
+        )
+        np.testing.assert_array_equal(got, want)
