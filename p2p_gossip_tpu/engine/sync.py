@@ -224,33 +224,54 @@ def _run_chunk_while(
     t_start: jnp.ndarray,    # scalar int32
     last_gen: jnp.ndarray,   # scalar int32
     churn=None,              # optional ((N, K), (N, K)) downtime intervals
+    snap_ticks=None,         # optional (K,) int32 periodic-stats boundaries
     *,
     chunk_size: int,
     horizon: int,
     block: int,
 ):
-    """Run one share chunk to quiescence (or the horizon) under while_loop."""
+    """Run one share chunk to quiescence (or the horizon) under while_loop.
+
+    With ``snap_ticks``, also returns (K, N) received counts captured the
+    moment the tick counter reaches each boundary — i.e. totals over all
+    ticks strictly before it, matching the event engine's snapshot timing
+    (PrintPeriodicStats, p2pnetwork.cc:231).
+    """
     n, w = dg.n, bitmask.num_words(chunk_size)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
+    k = 0 if snap_ticks is None else snap_ticks.shape[0]
     state = (
         t_start,
         jnp.zeros((n, w), dtype=jnp.uint32),
         jnp.zeros((dg.ring_size, n, w), dtype=jnp.uint32),
         jnp.zeros((n,), dtype=jnp.int32),
         jnp.zeros((n,), dtype=jnp.int32),
+        jnp.zeros((k, n), dtype=jnp.int32),
     )
 
     def cond(state):
-        t, _, hist, _, _ = state
+        t, _, hist, _, _, _ = state
         in_flight = jnp.any(hist != 0)
         pending = t <= last_gen
         return (t < horizon) & (in_flight | pending)
 
     def body(state):
-        return _tick_body(dg, block, state, origins, slots, gen_ticks, churn)
+        t, seen, hist, received, sent, snaps = state
+        if k:
+            snaps = jnp.where(
+                (snap_ticks == t)[:, None], received[None, :], snaps
+            )
+        t, seen, hist, received, sent = _tick_body(
+            dg, block, (t, seen, hist, received, sent), origins, slots,
+            gen_ticks, churn,
+        )
+        return (t, seen, hist, received, sent, snaps)
 
-    t, seen, hist, received, sent = jax.lax.while_loop(cond, body, state)
-    return seen, received, sent
+    t, seen, hist, received, sent, snaps = jax.lax.while_loop(cond, body, state)
+    if k:
+        # Boundaries at/after quiescence see the (unchanging) final counts.
+        snaps = jnp.where((snap_ticks >= t)[:, None], received[None, :], snaps)
+    return seen, received, sent, snaps
 
 
 @functools.partial(
@@ -308,6 +329,7 @@ def run_sync_sim(
     checkpoint_every: int = 1,
     stop_after_chunks: int | None = None,
     churn=None,
+    snapshot_ticks: list[int] | None = None,
 ) -> NodeStats:
     """Run the full simulation on the synchronous engine.
 
@@ -325,12 +347,29 @@ def run_sync_sim(
     ``churn`` is an optional `models.churn.ChurnModel`: nodes lose arrivals
     and skip generations while inside a downtime interval (same semantics,
     and identical counters, as the event engines run with the same model).
+
+    ``snapshot_ticks`` requests periodic-stats snapshots
+    (PrintPeriodicStats, p2pnetwork.cc:231): ``stats.extra["snapshots"]``
+    gets one entry per boundary with the totals over all ticks strictly
+    before it — identical values to the event engines' snapshots.
     """
     dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
     churn_dev = churn_to_device(churn)
     chunk_size = min(chunk_size, max(32, schedule.num_shares))
     # Round chunk size up to whole words.
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
+
+    # Boundaries past the horizon never fire on the event engine (its final
+    # flush is at horizon_ticks) — drop them here too for exact parity.
+    boundaries = (
+        sorted(b for b in snapshot_ticks if b <= horizon_ticks)
+        if snapshot_ticks
+        else []
+    )
+    snap_ticks_dev = (
+        jnp.asarray(boundaries, dtype=jnp.int32) if boundaries else None
+    )
+    snap_received = np.zeros((len(boundaries), graph.n), dtype=np.int64)
 
     start_chunk = 0
     ckpt_fp = None
@@ -348,6 +387,9 @@ def run_sync_sim(
             _canonical_delays(dg), dg.uniform_delay, dg.ring_size,
             churn.down_start if churn is not None else None,
             churn.down_end if churn is not None else None,
+            # Appended only when snapshots are on, so checkpoints from
+            # snapshot-free runs keep their pre-existing fingerprints.
+            *([np.asarray(boundaries, dtype=np.int64)] if boundaries else []),
         )
         loaded = ckpt.load_checkpoint(checkpoint_path)
         if loaded is not None:
@@ -374,11 +416,17 @@ def run_sync_sim(
     if start_chunk:
         received += arrays["received"].astype(np.int64)
         sent += arrays["sent"].astype(np.int64)
+        if boundaries:
+            snap_received += arrays["snap_received"].astype(np.int64)
 
     def save(next_chunk: int) -> None:
         ckpt.save_checkpoint(
             checkpoint_path,
-            {"received": received, "sent": sent},
+            {
+                "received": received,
+                "sent": sent,
+                "snap_received": snap_received,
+            },
             {"fingerprint": ckpt_fp, "next_chunk": next_chunk},
         )
 
@@ -401,13 +449,15 @@ def run_sync_sim(
                 )
             t_start = jnp.asarray(first_t, dtype=jnp.int32)
             last_gen = jnp.asarray(last_t, dtype=jnp.int32)
-            _, r, s = _run_chunk_while(
+            _, r, s, snaps = _run_chunk_while(
                 dg, jnp.asarray(origins), jnp.asarray(gen_ticks), t_start,
-                last_gen, churn_dev,
+                last_gen, churn_dev, snap_ticks_dev,
                 chunk_size=chunk_size, horizon=horizon_ticks, block=block,
             )
             received += np.asarray(r, dtype=np.int64)
             sent += np.asarray(s, dtype=np.int64)
+            if boundaries:
+                snap_received += np.asarray(snaps, dtype=np.int64)
         done_this_call += 1
         if checkpoint_path is not None and (
             done_this_call % checkpoint_every == 0 or ci == len(chunks) - 1
@@ -418,7 +468,7 @@ def run_sync_sim(
     degree = np.asarray(dg.degree, dtype=np.int64)
     # Generation itself also broadcasts (GossipShareToPeers, p2pnode.cc:123):
     # already folded into `sent` on-device via gen_cnt.
-    return NodeStats(
+    stats = NodeStats(
         generated=generated,
         received=received,
         forwarded=received.copy(),
@@ -426,6 +476,20 @@ def run_sync_sim(
         processed=generated + received,
         degree=degree,
     )
+    if boundaries:
+        connections = int(degree.sum())
+        stats.extra["snapshots"] = []
+        for i, b in enumerate(boundaries):
+            gen_b = int(effective_generated(schedule, b, churn).sum())
+            stats.extra["snapshots"].append(
+                {
+                    "tick": int(b),
+                    "generated": gen_b,
+                    "processed": gen_b + int(snap_received[i].sum()),
+                    "connections": connections,
+                }
+            )
+    return stats
 
 
 def run_flood_coverage(

@@ -98,7 +98,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--statsInterval", type=float, default=10.0,
-        help="Periodic stats interval in seconds (event/native backends)",
+        help="Periodic stats interval in seconds",
     )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--chunkSize", type=int, default=512)
@@ -258,6 +258,7 @@ def run(argv=None) -> int:
             checkpoint_path=args.checkpoint or None,
             checkpoint_every=args.checkpointEvery,
             churn=churn,
+            snapshot_ticks=snapshot_ticks,
         )
     elif args.backend == "native":
         from p2p_gossip_tpu.runtime.native import run_native_sim
@@ -276,7 +277,7 @@ def run(argv=None) -> int:
     wall = time.perf_counter() - t0
 
     # Periodic reports (PrintPeriodicStats, p2pnetwork.cc:201-204): exact
-    # mid-run snapshots when the engine records them (event backend).
+    # mid-run snapshots (all push backends; push-pull has no snapshot path).
     for snap in stats.extra.get("snapshots", []):
         avg = snap["processed"] // max(g.n, 1)
         print(

@@ -114,3 +114,30 @@ def test_parity_bucketed_device_graph():
         sp = run_sync_sim(g, sched, horizon, ell_delays=delays, device_graph=dg_p)
         assert sb.equal_counts(ev)
         assert sp.equal_counts(ev)
+
+
+def test_snapshot_parity_with_event_engine():
+    """Periodic-stats snapshots (PrintPeriodicStats) match the event oracle
+    exactly at every boundary, including boundaries past quiescence."""
+    g = pg.erdos_renyi(90, 0.06, seed=5)
+    sched = pg.uniform_renewal_schedule(90, sim_time=20.0, tick_dt=0.005, seed=5)
+    horizon = int(20.0 / 0.005)
+    boundaries = [500, 1000, 2000, 3500, horizon]
+    ev = run_event_sim(g, sched, horizon, snapshot_ticks=boundaries)
+    sy = run_sync_sim(g, sched, horizon, snapshot_ticks=boundaries)
+    assert sy.equal_counts(ev)
+    assert sy.extra["snapshots"] == ev.extra["snapshots"]
+    # Snapshots are cumulative and end at the final totals.
+    processed = [s["processed"] for s in sy.extra["snapshots"]]
+    assert processed == sorted(processed)
+    assert processed[-1] == sy.totals()["processed"]
+
+
+def test_snapshot_boundary_past_horizon_dropped():
+    """A boundary beyond the horizon never fires — on either engine."""
+    g = pg.erdos_renyi(40, 0.1, seed=1)
+    sched = pg.uniform_renewal_schedule(40, sim_time=1.0, tick_dt=0.005, seed=1)
+    ev = run_event_sim(g, sched, 200, snapshot_ticks=[100, 250])
+    sy = run_sync_sim(g, sched, 200, snapshot_ticks=[100, 250])
+    assert sy.extra["snapshots"] == ev.extra["snapshots"]
+    assert len(sy.extra["snapshots"]) == 1
