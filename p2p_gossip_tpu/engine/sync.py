@@ -53,7 +53,13 @@ from p2p_gossip_tpu.utils.stats import NodeStats
 
 log = p2plog.get_logger("Engine.Sync")
 
-DEFAULT_CHUNK_SIZE = 512
+DEFAULT_CHUNK_SIZE = 4096
+
+# Narrower share chunks leave the seen/hist minor dimension under the
+# TPU's 128-lane tile width, which demotes the hot row gather to a slow
+# path (measured ~15x worse bytes/s at 32 words vs 128). Auto-sizing
+# never shrinks below this; an explicit smaller chunk_size is honored.
+MIN_CHUNK_SHARES = 4096
 
 
 @dataclasses.dataclass(frozen=True)
@@ -275,7 +281,10 @@ def _run_chunk_while(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("chunk_size", "horizon", "block", "use_pallas")
+    jax.jit,
+    static_argnames=(
+        "chunk_size", "horizon", "block", "use_pallas", "coverage_slots"
+    ),
 )
 def _run_chunk_scan(
     dg: DeviceGraph,
@@ -287,11 +296,16 @@ def _run_chunk_scan(
     horizon: int,
     block: int,
     use_pallas: bool = False,
+    coverage_slots: int | None = None,
 ):
     """Fixed-horizon scan from t=0 recording per-tick coverage (S,) —
     drives the time-to-coverage metrics. ``use_pallas`` selects the one-pass
-    coverage kernel (ops/pallas_kernels.py) on TPU."""
+    coverage kernel (ops/pallas_kernels.py) on TPU. ``coverage_slots``
+    limits the recorded coverage to the first S slots (the live shares) —
+    the chunk itself may be lane-padded far wider (MIN_CHUNK_SHARES)."""
     n, w = dg.n, bitmask.num_words(chunk_size)
+    cov_slots = chunk_size if coverage_slots is None else coverage_slots
+    cov_w = bitmask.num_words(cov_slots)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
     state = (
         jnp.zeros((), dtype=jnp.int32),
@@ -303,12 +317,13 @@ def _run_chunk_scan(
 
     def step(state, _):
         state = _tick_body(dg, block, state, origins, slots, gen_ticks, churn)
+        live_seen = state[1][:, :cov_w]
         if use_pallas:
             from p2p_gossip_tpu.ops.pallas_kernels import coverage_per_slot_pallas
 
-            cov = coverage_per_slot_pallas(state[1], chunk_size)
+            cov = coverage_per_slot_pallas(live_seen, cov_slots)
         else:
-            cov = bitmask.coverage_per_slot(state[1], chunk_size)
+            cov = bitmask.coverage_per_slot(live_seen, cov_slots)
         return state, cov
 
     state, coverage = jax.lax.scan(step, state, None, length=horizon)
@@ -355,7 +370,7 @@ def run_sync_sim(
     """
     dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
     churn_dev = churn_to_device(churn)
-    chunk_size = min(chunk_size, max(32, schedule.num_shares))
+    chunk_size = min(chunk_size, max(MIN_CHUNK_SHARES, schedule.num_shares))
     # Round chunk size up to whole words.
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
 
@@ -476,7 +491,9 @@ def run_sync_sim(
         processed=generated + received,
         degree=degree,
     )
-    if boundaries:
+    if snapshot_ticks is not None:
+        # Present (possibly empty) whenever snapshots were requested, like
+        # the event engines.
         connections = int(degree.sum())
         stats.extra["snapshots"] = []
         for i, b in enumerate(boundaries):
@@ -510,7 +527,7 @@ def run_flood_coverage(
     """
     origins = np.asarray(origins, dtype=np.int32).reshape(-1)
     s = origins.shape[0]
-    chunk_size = bitmask.num_words(s) * bitmask.WORD_BITS
+    chunk_size = bitmask.num_words(max(s, MIN_CHUNK_SHARES)) * bitmask.WORD_BITS
     dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
     sched = Schedule(graph.n, origins, np.zeros(s, dtype=np.int32))
     o, g = sched.padded(chunk_size, horizon_ticks)
@@ -521,7 +538,7 @@ def run_flood_coverage(
     _, r, snt, cov = _run_chunk_scan(
         dg, jnp.asarray(o), jnp.asarray(g), churn_dev,
         chunk_size=chunk_size, horizon=horizon_ticks, block=block,
-        use_pallas=use_pallas,
+        use_pallas=use_pallas, coverage_slots=s,
     )
     generated = effective_generated(sched, horizon_ticks, churn)
     received = np.asarray(r, dtype=np.int64)

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from p2p_gossip_tpu.engine.sync import DeviceGraph
+from p2p_gossip_tpu.engine.sync import MIN_CHUNK_SHARES, DeviceGraph
 from p2p_gossip_tpu.models.generation import Schedule
 from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
@@ -155,7 +155,7 @@ def run_pushpull_sim(
             "push-pull requires a DeviceGraph built with bucketed=False "
             "(random partner selection reads the full ELL)"
         )
-    chunk_size = min(chunk_size, max(32, schedule.num_shares))
+    chunk_size = min(chunk_size, max(MIN_CHUNK_SHARES, schedule.num_shares))
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
     override = (
         jnp.asarray(partners_override, dtype=jnp.int32)

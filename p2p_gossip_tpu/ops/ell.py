@@ -26,6 +26,10 @@ from jax import lax
 
 DEFAULT_DEGREE_BLOCK = 8
 
+# Degree-bucket levels above this are quantized to powers of two (see
+# build_degree_buckets) and always form standalone buckets.
+GEOMETRIC_LEVEL_THRESHOLD = 8
+
 
 def detect_uniform_delay(ell_delays, ell_mask) -> int | None:
     """The single source of truth for choosing the uniform-delay fast path:
@@ -168,24 +172,44 @@ def build_degree_buckets(
     deg = np.asarray(graph.degree)
     ell_idx, ell_mask = ell if ell is not None else graph.ell()
     level = (deg + block - 1) // block  # cap = level * block
+    # Heavy-tailed graphs (e.g. Barabási–Albert) have hundreds of distinct
+    # high-degree levels with a handful of nodes each; min_rows merging would
+    # fold them all into one bucket padded to the hub degree. Quantize levels
+    # geometrically past 8*block so within-bucket padding stays < 2x.
+    high = level > GEOMETRIC_LEVEL_THRESHOLD
+    if high.any():
+        level = np.where(
+            high,
+            1 << np.ceil(np.log2(np.maximum(level, 1))).astype(np.int64),
+            level,
+        )
     order = np.argsort(level, kind="stable")
     sorted_level = level[order]
     # Split points where the level changes.
     change = np.flatnonzero(np.diff(sorted_level)) + 1
     groups = np.split(order, change)
-    # Merge small groups upward (next group has a >= cap, so padding stays valid).
+    # Merge small LINEAR-level groups upward (the next group's cap is
+    # higher, so padding stays valid). Geometric (tail) groups always stand
+    # alone: min_rows merging there would fold hundreds of small tail
+    # groups into one bucket padded to the hub degree.
     merged: list[np.ndarray] = []
     pending: list[np.ndarray] = []
     pending_count = 0
     for g in groups:
+        if level[g[0]] > GEOMETRIC_LEVEL_THRESHOLD:  # geometric group
+            if pending:
+                merged.append(np.concatenate(pending))
+                pending, pending_count = [], 0
+            merged.append(g)
+            continue
         pending.append(g)
         pending_count += g.shape[0]
         if pending_count >= min_rows:
             merged.append(np.concatenate(pending))
             pending, pending_count = [], 0
     if pending:
-        # Leftovers keep their own bucket: folding a high-degree tail into
-        # the previous bucket would raise that bucket's cap for every row.
+        # Leftovers keep their own bucket: folding a tail into the previous
+        # bucket would raise that bucket's cap for every row.
         merged.append(np.concatenate(pending))
     buckets = []
     for rows in merged:
@@ -221,7 +245,7 @@ def propagate_bucketed(
     results are scattered back into node order.
     """
     w = hist.shape[-1]
-    arrivals = jnp.zeros((n_out, w), dtype=jnp.uint32)
+    parts = []
     for rows, b_idx, b_mask, b_delay in buckets:
         if uniform_delay is not None:
             part = propagate_uniform(
@@ -233,8 +257,12 @@ def propagate_bucketed(
                 hist, tick, b_idx, b_delay, b_mask,
                 ring_size=ring_size, block=block,
             )
-        arrivals = arrivals.at[rows].set(part, mode="drop")
-    return arrivals
+        parts.append(part)
+    # One combined scatter back to node order (the rows arrays partition
+    # range(n_out)) instead of one full-array update per bucket.
+    order = jnp.concatenate([b[0] for b in buckets])
+    arrivals = jnp.zeros((n_out, w), dtype=jnp.uint32)
+    return arrivals.at[order].set(jnp.concatenate(parts), mode="drop")
 
 
 def propagate_reference(hist, tick, ell_idx, ell_delay, ell_mask, *, ring_size):

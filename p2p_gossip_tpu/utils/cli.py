@@ -101,7 +101,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="Periodic stats interval in seconds",
     )
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--chunkSize", type=int, default=512)
+    p.add_argument("--chunkSize", type=int, default=4096)
     p.add_argument(
         "--anim", type=str, default="",
         help="Write a NetAnim-style XML trace to this path",
@@ -120,12 +120,65 @@ def build_parser() -> argparse.ArgumentParser:
         help="Chunks between checkpoint writes (default 1)",
     )
     p.add_argument(
+        "--floodCoverage", type=int, default=0, metavar="S",
+        help="Coverage-time experiment instead of the gossip run: flood S "
+        "shares from random origins at t=0 and report per-share "
+        "time-to-99%%-coverage (tpu backend only)",
+    )
+    p.add_argument(
+        "--coverageFraction", type=float, default=0.99,
+        help="Coverage fraction reported by --floodCoverage (default 0.99)",
+    )
+    p.add_argument(
         "--log", type=str, default="",
         help="NS_LOG-style component log spec, e.g. "
         "'Engine.Event=debug:Engine.Sync=info' or '*=info' "
         "(also honors the P2P_LOG environment variable)",
     )
     return p
+
+
+def _run_flood_coverage_cli(args, g, horizon, delays, churn) -> int:
+    """Flood coverage-time experiment (BASELINE.json headline config): S
+    shares flooded from random origins at t=0, per-share
+    time-to-``coverageFraction`` reported in ticks and seconds."""
+    from p2p_gossip_tpu.engine.sync import run_flood_coverage, time_to_coverage
+
+    tick_dt = args.Latency / 1000.0
+    rng = np.random.default_rng(args.seed)
+    origins = rng.integers(0, g.n, args.floodCoverage).astype(np.int32)
+    t0 = time.perf_counter()
+    stats, coverage = run_flood_coverage(
+        g, origins, horizon, ell_delays=delays, churn=churn
+    )
+    wall = time.perf_counter() - t0
+    ttc = time_to_coverage(coverage, g.n, args.coverageFraction)
+    reached = ttc >= 0
+    print(
+        f"=== Flood Coverage ({args.floodCoverage} shares, target "
+        f"{args.coverageFraction:.0%} of {g.n} nodes) ==="
+    )
+    if reached.any():
+        ticks = ttc[reached]
+        print(
+            f"Shares reaching target: {int(reached.sum())}/{len(ttc)}\n"
+            f"Time to {args.coverageFraction:.0%} coverage: "
+            f"min {ticks.min()} / median {int(np.median(ticks))} / "
+            f"max {ticks.max()} ticks "
+            f"({ticks.min() * tick_dt:g}s / {np.median(ticks) * tick_dt:g}s / "
+            f"{ticks.max() * tick_dt:g}s)"
+        )
+    else:
+        print(f"Shares reaching target: 0/{len(ttc)} within {horizon} ticks")
+    print(
+        f"Final coverage: min {coverage[-1].min()} / "
+        f"mean {coverage[-1].mean():.1f} / max {coverage[-1].max()} nodes"
+    )
+    print(
+        f"Simulated {horizon} ticks in {wall:.3f}s wall "
+        f"({stats.totals()['processed'] / max(wall, 1e-9):.3g} node-updates/s)"
+    )
+    return 0
 
 
 def run(argv=None) -> int:
@@ -225,6 +278,29 @@ def run(argv=None) -> int:
         if interval_ticks > 0
         else []
     )
+
+    if args.floodCoverage:
+        if args.floodCoverage < 0:
+            print(
+                f"error: --floodCoverage must be positive, got "
+                f"{args.floodCoverage}",
+                file=sys.stderr,
+            )
+            return 2
+        if args.backend != "tpu" or args.protocol != "push":
+            print(
+                "error: --floodCoverage requires --backend tpu --protocol push",
+                file=sys.stderr,
+            )
+            return 2
+        if not 0.0 < args.coverageFraction <= 1.0:
+            print(
+                "error: --coverageFraction must be in (0, 1], got "
+                f"{args.coverageFraction:g}",
+                file=sys.stderr,
+            )
+            return 2
+        return _run_flood_coverage_cli(args, g, horizon, delays, churn)
 
     if args.protocol == "pushpull" and args.backend != "tpu":
         print("error: --protocol pushpull requires --backend tpu", file=sys.stderr)

@@ -63,3 +63,23 @@ def test_bad_flag_fails_cleanly():
     r = _run_cli("--backend", "gpu")
     assert r.returncode != 0
     assert "invalid choice" in r.stderr
+
+
+def test_flood_coverage_flag(capsys):
+    from p2p_gossip_tpu.utils.cli import run
+
+    rc = run([
+        "--numNodes", "60", "--connectionProb", "0.1", "--simTime", "0.2",
+        "--Latency", "5", "--floodCoverage", "8", "--seed", "2",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Flood Coverage (8 shares" in out
+    assert "Shares reaching target: 8/8" in out
+
+
+def test_flood_coverage_requires_tpu_backend(capsys):
+    from p2p_gossip_tpu.utils.cli import run
+
+    rc = run(["--numNodes", "20", "--floodCoverage", "4", "--backend", "event"])
+    assert rc == 2

@@ -141,3 +141,41 @@ def test_snapshot_boundary_past_horizon_dropped():
     sy = run_sync_sim(g, sched, 200, snapshot_ticks=[100, 250])
     assert sy.extra["snapshots"] == ev.extra["snapshots"]
     assert len(sy.extra["snapshots"]) == 1
+
+
+def test_snapshot_parity_multi_chunk_and_resume(tmp_path):
+    """Snapshot accumulation is exact across share chunks, and the
+    accumulated snapshot counts survive a checkpoint interrupt/resume."""
+    g = pg.erdos_renyi(80, 0.08, seed=7)
+    sched = pg.uniform_renewal_schedule(80, sim_time=20.0, tick_dt=0.005, seed=7)
+    horizon = int(20.0 / 0.005)
+    boundaries = [800, 2000, 3200]
+    ev = run_event_sim(g, sched, horizon, snapshot_ticks=boundaries)
+    # Small explicit chunk => several chunks.
+    sy = run_sync_sim(
+        g, sched, horizon, chunk_size=256, snapshot_ticks=boundaries
+    )
+    assert sched.num_shares > 256  # really multi-chunk
+    assert sy.equal_counts(ev)
+    assert sy.extra["snapshots"] == ev.extra["snapshots"]
+
+    # Interrupt after one chunk, then resume from the checkpoint.
+    ckpt = str(tmp_path / "snap.npz")
+    part = run_sync_sim(
+        g, sched, horizon, chunk_size=256, snapshot_ticks=boundaries,
+        checkpoint_path=ckpt, stop_after_chunks=1,
+    )
+    resumed = run_sync_sim(
+        g, sched, horizon, chunk_size=256, snapshot_ticks=boundaries,
+        checkpoint_path=ckpt,
+    )
+    assert resumed.equal_counts(ev)
+    assert resumed.extra["snapshots"] == ev.extra["snapshots"]
+
+
+def test_snapshots_all_past_horizon_empty_list():
+    g = pg.erdos_renyi(30, 0.15, seed=2)
+    sched = pg.uniform_renewal_schedule(30, sim_time=0.5, tick_dt=0.005, seed=2)
+    ev = run_event_sim(g, sched, 100, snapshot_ticks=[500])
+    sy = run_sync_sim(g, sched, 100, snapshot_ticks=[500])
+    assert sy.extra["snapshots"] == ev.extra["snapshots"] == []
