@@ -55,9 +55,14 @@ def _padded_device_graph(
     ell_idx = pad_to_multiple(ell_idx, n_node_shards)
     uniform = detect_uniform_delay(ell_delays, ell_mask)
     ell_mask = pad_to_multiple(ell_mask, n_node_shards)
-    ell_delays = pad_to_multiple(ell_delays, n_node_shards, fill=1)
+    ring = (int(ell_delays.max()) if ell_delays.size else 1) + 1
+    if uniform is not None:
+        # The uniform fast path never reads per-edge delays: stage one
+        # placeholder row per shard instead of (N, dmax) of dead HBM.
+        ell_delays = np.ones((ell_idx.shape[0], 1), dtype=np.int32)
+    else:
+        ell_delays = pad_to_multiple(ell_delays, n_node_shards, fill=1)
     degree = pad_to_multiple(graph.degree.astype(np.int32), n_node_shards)
-    ring = int(ell_delays.max()) + 1 if ell_delays.size else 2
     return ell_idx, ell_delays, ell_mask, degree, ring, uniform
 
 
@@ -181,13 +186,19 @@ def run_sharded_sim(
     mesh: Mesh,
     ell_delays: np.ndarray | None = None,
     constant_delay: int = 1,
-    chunk_size: int = 256,
+    chunk_size: int = 4096,
     block: int = DEFAULT_DEGREE_BLOCK,
     churn=None,
 ) -> NodeStats:
     """Drop-in counterpart of run_sync_sim/run_event_sim on a device mesh:
     identical per-node counters, any number of shares — including under a
-    `models.churn.ChurnModel` (intervals shard with their node rows)."""
+    `models.churn.ChurnModel` (intervals shard with their node rows).
+
+    ``chunk_size`` is per share-shard. The 4096 default keeps the bitmask
+    minor dimension at the TPU's full 128-lane tile width — narrower chunks
+    demote the hot gather to a measured ~15x slower path (see
+    engine.sync.MIN_CHUNK_SHARES); tests use small chunks on CPU where only
+    chunking semantics matter."""
     n_node_shards = mesh.shape[NODES_AXIS]
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
     ell_idx, ell_delay, ell_mask, degree, ring, uniform = _padded_device_graph(

@@ -12,6 +12,8 @@ The scale axis of the reference is one CPU core; ours is a
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -28,7 +30,27 @@ def make_mesh(
 ) -> Mesh:
     """Build a (shares, nodes) mesh. Defaults to all devices on the nodes
     axis (frontier exchange prefers the faster/denser axis)."""
-    devices = list(devices if devices is not None else jax.devices())
+    if devices is None:
+        # Honor an explicitly configured default device or JAX_PLATFORMS
+        # (experimental TPU plugins can register even when the user pinned
+        # another platform, polluting bare jax.devices()).
+        default = jax.config.jax_default_device
+        platforms = os.environ.get("JAX_PLATFORMS", "")
+        first = platforms.split(",")[0].strip()
+        if default is not None:
+            # jax_default_device may be a Device or a platform-name string.
+            platform = default if isinstance(default, str) else default.platform
+            devices = jax.devices(platform)
+        elif first:
+            try:
+                devices = jax.devices(first)
+            except RuntimeError:
+                # Mirror JAX's own multi-entry fallback (e.g. "cuda,cpu"
+                # without CUDA installed).
+                devices = jax.devices()
+        else:
+            devices = jax.devices()
+    devices = list(devices)
     if n_node_shards is None:
         n_node_shards = len(devices) // n_share_shards
     want = n_node_shards * n_share_shards

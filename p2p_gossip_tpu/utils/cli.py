@@ -45,8 +45,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--Latency", type=float, default=5.0, help="latency in ms")
     # Framework extensions.
     p.add_argument(
-        "--backend", choices=("tpu", "event", "native"), default="tpu",
-        help="Execution engine (default: tpu)",
+        "--backend", choices=("tpu", "sharded", "event", "native"),
+        default="tpu",
+        help="Execution engine (default: tpu; sharded = multi-chip "
+        "shard_map engine over a device mesh)",
+    )
+    p.add_argument(
+        "--meshNodes", type=int, default=0,
+        help="Node-axis shards for --backend sharded (default: all devices)",
+    )
+    p.add_argument(
+        "--meshShares", type=int, default=1,
+        help="Share-axis shards for --backend sharded",
     )
     p.add_argument(
         "--topology", choices=("er", "ba", "ring", "ws", "grid", "torus"),
@@ -308,6 +318,12 @@ def run(argv=None) -> int:
     if churn is not None and args.protocol != "push":
         print("error: --churnProb requires --protocol push", file=sys.stderr)
         return 2
+    if args.meshNodes < 0 or args.meshShares < 1:
+        print(
+            "error: --meshNodes must be >= 0 and --meshShares >= 1",
+            file=sys.stderr,
+        )
+        return 2
     if args.checkpoint and (args.backend != "tpu" or args.protocol != "push"):
         print(
             "error: --checkpoint requires --backend tpu --protocol push",
@@ -335,6 +351,25 @@ def run(argv=None) -> int:
             checkpoint_every=args.checkpointEvery,
             churn=churn,
             snapshot_ticks=snapshot_ticks,
+        )
+    elif args.backend == "sharded":
+        from p2p_gossip_tpu.parallel.engine_sharded import run_sharded_sim
+        from p2p_gossip_tpu.parallel.mesh import make_mesh
+
+        if snapshot_ticks:
+            print(
+                "warning: periodic stats are not supported on --backend "
+                "sharded; only final statistics will be printed",
+                file=sys.stderr,
+            )
+        mesh = make_mesh(args.meshNodes or None, args.meshShares)
+        print(
+            f"Mesh: {mesh.shape['shares']} share-shards x "
+            f"{mesh.shape['nodes']} node-shards"
+        )
+        stats = run_sharded_sim(
+            g, sched, horizon, mesh, ell_delays=delays,
+            chunk_size=args.chunkSize, churn=churn,
         )
     elif args.backend == "native":
         from p2p_gossip_tpu.runtime.native import run_native_sim

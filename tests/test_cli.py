@@ -83,3 +83,24 @@ def test_flood_coverage_requires_tpu_backend(capsys):
 
     rc = run(["--numNodes", "20", "--floodCoverage", "4", "--backend", "event"])
     assert rc == 2
+
+
+def test_sharded_backend_cli(capsys):
+    """--backend sharded over the 8 virtual CPU devices matches the event
+    backend's final statistics."""
+    from p2p_gossip_tpu.utils.cli import run
+
+    common = [
+        "--numNodes", "50", "--connectionProb", "0.1", "--simTime", "5",
+        "--Latency", "5", "--seed", "4", "--chunkSize", "64",
+    ]
+    assert run(common + ["--backend", "sharded", "--meshNodes", "4",
+                         "--meshShares", "2"]) == 0
+    sharded_out = capsys.readouterr().out
+    assert run(common + ["--backend", "event"]) == 0
+    event_out = capsys.readouterr().out
+
+    def node_lines(s):
+        return [l for l in s.splitlines() if l.startswith(("Node", "Total"))]
+
+    assert node_lines(sharded_out) == node_lines(event_out)
