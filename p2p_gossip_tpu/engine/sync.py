@@ -12,7 +12,8 @@ synchronous graph message-passing simulation designed for XLA:
 - counters (p2pnode.h:40-43) update via `lax.population_count` each tick;
 - time advances under `lax.while_loop` with a convergence predicate (the
   chunk ends as soon as no message is in flight and no generation is
-  pending), or under `lax.scan` when per-tick coverage history is recorded.
+  pending); coverage-history runs record per-tick coverage into a
+  preallocated buffer inside the same loop, so they exit early too.
 
 Arbitrary total share counts are processed in fixed-size chunks — shares are
 independent, counters are additive — so every XLA compilation sees static
@@ -286,7 +287,7 @@ def _run_chunk_while(
         "chunk_size", "horizon", "block", "use_pallas", "coverage_slots"
     ),
 )
-def _run_chunk_scan(
+def _run_chunk_coverage(
     dg: DeviceGraph,
     origins: jnp.ndarray,
     gen_ticks: jnp.ndarray,
@@ -298,36 +299,58 @@ def _run_chunk_scan(
     use_pallas: bool = False,
     coverage_slots: int | None = None,
 ):
-    """Fixed-horizon scan from t=0 recording per-tick coverage (S,) —
-    drives the time-to-coverage metrics. ``use_pallas`` selects the one-pass
-    coverage kernel (ops/pallas_kernels.py) on TPU. ``coverage_slots``
-    limits the recorded coverage to the first S slots (the live shares) —
-    the chunk itself may be lane-padded far wider (MIN_CHUNK_SHARES)."""
+    """Coverage-recording run from t=0 — drives the time-to-coverage
+    metrics. Returns per-tick coverage (horizon, S) but exits the tick loop
+    at quiescence (coverage is constant once nothing is in flight; the
+    remaining rows are filled with the final value), so a generous horizon
+    costs nothing extra. ``use_pallas`` selects the one-pass coverage
+    kernel (ops/pallas_kernels.py) on TPU. ``coverage_slots`` limits the
+    recorded coverage to the first S slots (the live shares) — the chunk
+    itself may be lane-padded far wider (MIN_CHUNK_SHARES)."""
     n, w = dg.n, bitmask.num_words(chunk_size)
     cov_slots = chunk_size if coverage_slots is None else coverage_slots
     cov_w = bitmask.num_words(cov_slots)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
+    last_gen = jnp.max(jnp.where(gen_ticks < horizon, gen_ticks, 0))
+
+    def coverage_of(seen):
+        live_seen = seen[:, :cov_w]
+        if use_pallas:
+            from p2p_gossip_tpu.ops.pallas_kernels import coverage_per_slot_pallas
+
+            return coverage_per_slot_pallas(live_seen, cov_slots)
+        return bitmask.coverage_per_slot(live_seen, cov_slots)
+
     state = (
         jnp.zeros((), dtype=jnp.int32),
         jnp.zeros((n, w), dtype=jnp.uint32),
         jnp.zeros((dg.ring_size, n, w), dtype=jnp.uint32),
         jnp.zeros((n,), dtype=jnp.int32),
         jnp.zeros((n,), dtype=jnp.int32),
+        jnp.zeros((horizon, cov_slots), dtype=jnp.int32),
     )
 
-    def step(state, _):
-        state = _tick_body(dg, block, state, origins, slots, gen_ticks, churn)
-        live_seen = state[1][:, :cov_w]
-        if use_pallas:
-            from p2p_gossip_tpu.ops.pallas_kernels import coverage_per_slot_pallas
+    def cond(full_state):
+        t, _, hist, _, _, _ = full_state
+        return (t < horizon) & (jnp.any(hist != 0) | (t <= last_gen))
 
-            cov = coverage_per_slot_pallas(live_seen, cov_slots)
-        else:
-            cov = bitmask.coverage_per_slot(live_seen, cov_slots)
-        return state, cov
+    def step(full_state):
+        t, seen, hist, received, sent, cov_hist = full_state
+        state = _tick_body(
+            dg, block, (t, seen, hist, received, sent), origins, slots,
+            gen_ticks, churn,
+        )
+        cov_hist = jax.lax.dynamic_update_slice(
+            cov_hist, coverage_of(state[1])[None], (t, 0)
+        )
+        return (*state, cov_hist)
 
-    state, coverage = jax.lax.scan(step, state, None, length=horizon)
-    _, seen, _, received, sent = state
+    t, seen, _, received, sent, cov_hist = jax.lax.while_loop(
+        cond, step, state
+    )
+    # Rows past quiescence hold the (monotone, now constant) final coverage.
+    ticks = jnp.arange(horizon, dtype=jnp.int32)[:, None]
+    coverage = jnp.where(ticks >= t, coverage_of(seen)[None, :], cov_hist)
     return seen, received, sent, coverage
 
 
@@ -535,7 +558,7 @@ def run_flood_coverage(
     # even though a TPU plugin is registered).
     use_pallas = any(d.platform == "tpu" for d in dg.ell_idx.devices())
     churn_dev = churn_to_device(churn)
-    _, r, snt, cov = _run_chunk_scan(
+    _, r, snt, cov = _run_chunk_coverage(
         dg, jnp.asarray(o), jnp.asarray(g), churn_dev,
         chunk_size=chunk_size, horizon=horizon_ticks, block=block,
         use_pallas=use_pallas, coverage_slots=s,

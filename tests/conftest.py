@@ -5,7 +5,12 @@ hardware (the driver's dryrun does the same)."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+# The experimental TPU plugin (injected via PYTHONPATH) initializes its
+# device tunnel at `import jax` even when JAX_PLATFORMS=cpu; a slow or
+# down tunnel then stalls every CPU-only test. Tests never want it —
+# drop it from the module search path before jax loads.
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
