@@ -34,10 +34,11 @@ def main() -> None:
 
     n, p, seed = 100_000, 0.001, 0
     n_shares, gen_window, horizon = 8192, 16, 64
-    # Swept on the real chip (2026-07): 8192 shares (W=256 words keeps the
-    # row gather on wide 1KB rows) x degree block 64 is the throughput peak —
-    # ~1.2x over the previous 4096/16 config; 16384 shares regresses.
-    chunk_size, block = 8192, 64
+    # Swept on the real chip (2026-07): 8192-share chunks (W=256 words keeps
+    # the row gather on wide 1KB rows) are the throughput peak — ~1.2x over
+    # 4096; 16384 regresses. The degree block auto-resolves to the swept
+    # TPU optimum (ops/ell.py TUNED_TPU_BLOCK).
+    chunk_size = 8192
 
     log(f"devices: {jax.devices()}")
     t0 = time.perf_counter()
@@ -60,11 +61,11 @@ def main() -> None:
     jax.block_until_ready(dg.ell_idx)
 
     t0 = time.perf_counter()
-    warm = run_sync_sim(graph, sched, horizon, chunk_size=chunk_size, block=block, device_graph=dg)
+    warm = run_sync_sim(graph, sched, horizon, chunk_size=chunk_size, device_graph=dg)
     log(f"warmup (incl. compile): {time.perf_counter() - t0:.1f}s")
 
     t0 = time.perf_counter()
-    stats = run_sync_sim(graph, sched, horizon, chunk_size=chunk_size, block=block, device_graph=dg)
+    stats = run_sync_sim(graph, sched, horizon, chunk_size=chunk_size, device_graph=dg)
     tpu_wall = time.perf_counter() - t0
     processed = stats.totals()["processed"]
     assert stats.totals() == warm.totals()

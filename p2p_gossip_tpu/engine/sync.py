@@ -48,6 +48,7 @@ from p2p_gossip_tpu.ops.ell import (
     propagate,
     propagate_bucketed,
     propagate_uniform,
+    tuned_degree_block,
 )
 from p2p_gossip_tpu.utils import logging as p2plog
 from p2p_gossip_tpu.utils.stats import NodeStats
@@ -122,6 +123,19 @@ class DeviceGraph:
             uniform_delay=uniform,
             buckets=buckets,
         )
+
+
+def _resolve_block(dg: DeviceGraph, block: int | None) -> int:
+    """``block=None`` means auto: the swept TPU optimum capped by the staged
+    max degree (`ops.ell.tuned_degree_block`). Results are bitwise identical
+    for any block — this only picks the fastest gather shape."""
+    if block is not None:
+        return block
+    if dg.buckets is not None:
+        dmax = max(b[1].shape[1] for b in dg.buckets)
+    else:
+        dmax = dg.ell_idx.shape[1]
+    return tuned_degree_block(dmax, dg.ell_idx.devices())
 
 
 def _canonical_delays(dg: DeviceGraph) -> np.ndarray:
@@ -361,7 +375,7 @@ def run_sync_sim(
     ell_delays: np.ndarray | None = None,
     constant_delay: int = 1,
     chunk_size: int = DEFAULT_CHUNK_SIZE,
-    block: int = DEFAULT_DEGREE_BLOCK,
+    block: int | None = None,
     device_graph: DeviceGraph | None = None,
     checkpoint_path: str | None = None,
     checkpoint_every: int = 1,
@@ -392,6 +406,7 @@ def run_sync_sim(
     before it — identical values to the event engines' snapshots.
     """
     dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
+    block = _resolve_block(dg, block)
     churn_dev = churn_to_device(churn)
     chunk_size = min(chunk_size, max(MIN_CHUNK_SHARES, schedule.num_shares))
     # Round chunk size up to whole words.
@@ -538,7 +553,7 @@ def run_flood_coverage(
     horizon_ticks: int,
     ell_delays: np.ndarray | None = None,
     constant_delay: int = 1,
-    block: int = DEFAULT_DEGREE_BLOCK,
+    block: int | None = None,
     device_graph: DeviceGraph | None = None,
     churn=None,
 ):
@@ -552,6 +567,7 @@ def run_flood_coverage(
     s = origins.shape[0]
     chunk_size = bitmask.num_words(max(s, MIN_CHUNK_SHARES)) * bitmask.WORD_BITS
     dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
+    block = _resolve_block(dg, block)
     sched = Schedule(graph.n, origins, np.zeros(s, dtype=np.int32))
     o, g = sched.padded(chunk_size, horizon_ticks)
     # Gate on where the graph actually lives (tests pin data to host CPU

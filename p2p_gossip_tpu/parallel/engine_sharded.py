@@ -36,6 +36,7 @@ from p2p_gossip_tpu.ops.ell import (
     detect_uniform_delay,
     propagate,
     propagate_uniform,
+    tuned_degree_block,
 )
 from p2p_gossip_tpu.parallel.mesh import NODES_AXIS, SHARES_AXIS, pad_to_multiple
 from p2p_gossip_tpu.utils.stats import NodeStats
@@ -187,7 +188,7 @@ def run_sharded_sim(
     ell_delays: np.ndarray | None = None,
     constant_delay: int = 1,
     chunk_size: int = 4096,
-    block: int = DEFAULT_DEGREE_BLOCK,
+    block: int | None = None,
     churn=None,
 ) -> NodeStats:
     """Drop-in counterpart of run_sync_sim/run_event_sim on a device mesh:
@@ -205,6 +206,10 @@ def run_sharded_sim(
         graph, ell_delays, constant_delay, n_node_shards
     )
     n_padded = ell_idx.shape[0]
+    if block is None:
+        # Auto: the swept TPU optimum capped by the staged max degree
+        # (bitwise-identical results for any block; perf only).
+        block = tuned_degree_block(ell_idx.shape[1], mesh.devices.flat)
     if churn is not None:
         churn_start = pad_to_multiple(churn.down_start, n_node_shards)
         churn_end = pad_to_multiple(churn.down_end, n_node_shards)

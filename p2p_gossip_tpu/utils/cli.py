@@ -113,6 +113,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--chunkSize", type=int, default=4096)
     p.add_argument(
+        "--degreeBlock", type=int, default=0,
+        help="Degree-block for the gather-OR scan (tpu/sharded backends; "
+        "0 = auto: the swept TPU optimum, conservative default on CPU)",
+    )
+    p.add_argument(
         "--anim", type=str, default="",
         help="Write a NetAnim-style XML trace to this path",
     )
@@ -159,7 +164,8 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn) -> int:
     origins = rng.integers(0, g.n, args.floodCoverage).astype(np.int32)
     t0 = time.perf_counter()
     stats, coverage = run_flood_coverage(
-        g, origins, horizon, ell_delays=delays, churn=churn
+        g, origins, horizon, ell_delays=delays,
+        block=args.degreeBlock or None, churn=churn,
     )
     wall = time.perf_counter() - t0
     ttc = time_to_coverage(coverage, g.n, args.coverageFraction)
@@ -249,6 +255,10 @@ def run(argv=None) -> int:
             g, args.delayMeanTicks, args.delaySigma, args.delayMaxTicks,
             seed=args.seed,
         )
+
+    if args.degreeBlock < 0:
+        print("error: --degreeBlock must be >= 0", file=sys.stderr)
+        return 2
 
     churn = None
     if not 0.0 <= args.churnProb <= 1.0:
@@ -347,6 +357,7 @@ def run(argv=None) -> int:
 
         stats = run_sync_sim(
             g, sched, horizon, ell_delays=delays, chunk_size=args.chunkSize,
+            block=args.degreeBlock or None,
             checkpoint_path=args.checkpoint or None,
             checkpoint_every=args.checkpointEvery,
             churn=churn,
@@ -369,7 +380,8 @@ def run(argv=None) -> int:
         )
         stats = run_sharded_sim(
             g, sched, horizon, mesh, ell_delays=delays,
-            chunk_size=args.chunkSize, churn=churn,
+            chunk_size=args.chunkSize, block=args.degreeBlock or None,
+            churn=churn,
         )
     elif args.backend == "native":
         from p2p_gossip_tpu.runtime.native import run_native_sim
