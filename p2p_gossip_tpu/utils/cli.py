@@ -64,6 +64,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="Topology family (er = reference's random topology; ws = "
         "Watts-Strogatz small-world; grid/torus = 2D lattice)",
     )
+    p.add_argument(
+        "--graphBuilder", choices=("auto", "native", "python"),
+        default="python",
+        help="Graph construction path for er/ba: the C++ builder "
+        "(runtime/native.py) or vectorized numpy. The two are "
+        "distribution-identical but use different RNG streams, so a given "
+        "--seed yields a different (equally valid) graph per builder — the "
+        "python default keeps seeds reproducible on machines without the "
+        "native library. Use native (or auto = native when built) for "
+        "million-node graphs, where the python builder is impractically "
+        "slow.",
+    )
     p.add_argument("--baM", type=int, default=3, help="Edges per node for --topology ba")
     p.add_argument("--wsK", type=int, default=4, help="Lattice degree for --topology ws")
     p.add_argument(
@@ -211,10 +223,44 @@ def run(argv=None) -> int:
     p2plog.set_time_resolution(tick_dt)
     horizon = int(round(args.simTime / tick_dt))
 
+    use_native_builder = False
+    if args.graphBuilder != "python" and args.topology in ("er", "ba"):
+        from p2p_gossip_tpu.runtime import native as native_rt
+
+        use_native_builder = native_rt.available()
+        if args.graphBuilder == "native" and not use_native_builder:
+            print(
+                "error: --graphBuilder native: the native library is not "
+                "built (run `make -C native`)",
+                file=sys.stderr,
+            )
+            return 2
+    elif args.graphBuilder == "native":
+        print(
+            f"error: --graphBuilder native has no {args.topology} builder "
+            "(only er/ba)",
+            file=sys.stderr,
+        )
+        return 2
+
     if args.topology == "er":
-        g = topo.erdos_renyi(args.numNodes, args.connectionProb, seed=args.seed)
+        g = (
+            native_rt.native_erdos_renyi(
+                args.numNodes, args.connectionProb, seed=args.seed
+            )
+            if use_native_builder
+            else topo.erdos_renyi(
+                args.numNodes, args.connectionProb, seed=args.seed
+            )
+        )
     elif args.topology == "ba":
-        g = topo.barabasi_albert(args.numNodes, m=args.baM, seed=args.seed)
+        g = (
+            native_rt.native_barabasi_albert(
+                args.numNodes, m=args.baM, seed=args.seed
+            )
+            if use_native_builder
+            else topo.barabasi_albert(args.numNodes, m=args.baM, seed=args.seed)
+        )
     elif args.topology == "ws":
         g = topo.watts_strogatz(
             args.numNodes, k=args.wsK, beta=args.wsBeta, seed=args.seed
@@ -280,11 +326,16 @@ def run(argv=None) -> int:
             seed=args.seed + 7919,
         )
 
+    builder_note = (
+        f", graph-builder={'native' if use_native_builder else 'python'}"
+        if args.topology in ("er", "ba")
+        else ""
+    )
     print(
         f"Starting gossip network simulation: {g.n} nodes, "
         f"{g.num_edges} links, {sched.num_shares} shares scheduled, "
         f"{horizon} ticks ({args.simTime:g}s at {args.Latency:g}ms), "
-        f"backend={args.backend}"
+        f"backend={args.backend}{builder_note}"
     )
     if churn is not None:
         n_outages = int((churn.down_end > churn.down_start).sum())

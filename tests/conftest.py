@@ -6,10 +6,11 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-# The experimental TPU plugin (injected via PYTHONPATH) initializes its
-# device tunnel at `import jax` even when JAX_PLATFORMS=cpu; a slow or
-# down tunnel then stalls every CPU-only test. Tests never want it —
-# drop it from the module search path before jax loads.
+# This box injects an experimental TPU plugin ("axon") via a PYTHONPATH
+# sitecustomize, so it registers at interpreter startup — before this file
+# runs. Dropping its path here cannot undo that registration (the factory
+# pop below is the actual fix); it only keeps later imports from touching
+# the plugin package.
 sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -19,9 +20,23 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# On this box an experimental TPU plugin ("axon") registers regardless of
-# JAX_PLATFORMS, so pin the default device to CPU explicitly; sharding tests
-# grab the 8 virtual devices via jax.devices("cpu").
+# The first `jax.devices()` call initializes EVERY registered backend —
+# dialing the plugin's TPU tunnel from CPU-only tests, and hanging the
+# whole suite when the tunnel is down. Importing jax is safe (init is
+# lazy); deregister the plugin's backend factory before anything triggers
+# init. Best-effort via private jax internals: on a jax version that moves
+# them, degrade to the pre-existing behavior (tests need a live tunnel)
+# rather than failing collection.
 import jax  # noqa: E402
+
+try:
+    import jax._src.xla_bridge as _xb
+
+    getattr(_xb, "_backend_factories", {}).pop("axon", None)
+except Exception:
+    pass
+# The plugin also pins jax_platforms via config (which outranks the
+# JAX_PLATFORMS env var set above) — pin it back.
+jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_default_device", jax.devices("cpu")[0])

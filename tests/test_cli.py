@@ -12,7 +12,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _run_cli(*args):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env.setdefault("PYTHONPATH", REPO)
+    # Replace (not setdefault) PYTHONPATH: the box injects an experimental
+    # TPU plugin via PYTHONPATH sitecustomize that force-pins jax to the
+    # device tunnel — a down tunnel would hang these CPU-only subprocesses.
+    env["PYTHONPATH"] = REPO
     return subprocess.run(
         [sys.executable, "-m", "p2p_gossip_tpu", *args],
         capture_output=True, text=True, cwd=REPO, env=env, timeout=300,
@@ -104,3 +107,32 @@ def test_sharded_backend_cli(capsys):
         return [l for l in s.splitlines() if l.startswith(("Node", "Total"))]
 
     assert node_lines(sharded_out) == node_lines(event_out)
+
+
+def test_graph_builder_flag(capsys):
+    """--graphBuilder selects the construction path: python is the
+    reproducible default, native uses the C++ builder when built, and both
+    produce valid full-coverage runs; native is rejected for topologies
+    without a C++ builder."""
+    from p2p_gossip_tpu.runtime import native
+    from p2p_gossip_tpu.utils.cli import run
+
+    common = [
+        "--numNodes", "30", "--connectionProb", "0.2", "--simTime", "5",
+        "--Latency", "5", "--seed", "2", "--backend", "event",
+    ]
+    assert run(common + ["--graphBuilder", "python"]) == 0
+    out = capsys.readouterr().out
+    assert "graph-builder=python" in out
+
+    if native.available():
+        assert run(common + ["--graphBuilder", "native"]) == 0
+        out = capsys.readouterr().out
+        assert "graph-builder=native" in out
+
+    # No native builder exists for ring: explicit native must fail cleanly.
+    assert run(
+        ["--numNodes", "10", "--topology", "ring", "--graphBuilder",
+         "native", "--backend", "event"]
+    ) == 2
+    assert "no ring builder" in capsys.readouterr().err
