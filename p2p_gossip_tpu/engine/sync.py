@@ -174,6 +174,33 @@ jax.tree_util.register_pytree_node(
 )
 
 
+def filter_snapshot_boundaries(snapshot_ticks, horizon_ticks) -> list[int]:
+    """Boundaries past the horizon never fire on the event engine (its
+    final flush is at horizon_ticks) — drop them everywhere for parity."""
+    if not snapshot_ticks:
+        return []
+    return sorted(b for b in snapshot_ticks if b <= horizon_ticks)
+
+
+def assemble_snapshots(schedule, churn, boundaries, snap_received, connections):
+    """The periodic-stats entries (PrintPeriodicStats, p2pnetwork.cc:231)
+    from per-boundary received totals — the one snapshot-dict convention
+    shared by the sync and sharded engines (the parity tests compare these
+    against the event engine's)."""
+    snapshots = []
+    for i, b in enumerate(boundaries):
+        gen_b = int(effective_generated(schedule, b, churn).sum())
+        snapshots.append(
+            {
+                "tick": int(b),
+                "generated": gen_b,
+                "processed": gen_b + int(snap_received[i].sum()),
+                "connections": int(connections),
+            }
+        )
+    return snapshots
+
+
 def apply_tick_updates(seen, arrivals, gen_bits, gen_cnt, received, sent, degree):
     """The shared counter semantics of one tick (reference: p2pnode.cc
     ReceiveShare/GenerateAndGossipShare): dedup against ``seen``, count
@@ -412,13 +439,7 @@ def run_sync_sim(
     # Round chunk size up to whole words.
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
 
-    # Boundaries past the horizon never fire on the event engine (its final
-    # flush is at horizon_ticks) — drop them here too for exact parity.
-    boundaries = (
-        sorted(b for b in snapshot_ticks if b <= horizon_ticks)
-        if snapshot_ticks
-        else []
-    )
+    boundaries = filter_snapshot_boundaries(snapshot_ticks, horizon_ticks)
     snap_ticks_dev = (
         jnp.asarray(boundaries, dtype=jnp.int32) if boundaries else None
     )
@@ -532,18 +553,9 @@ def run_sync_sim(
     if snapshot_ticks is not None:
         # Present (possibly empty) whenever snapshots were requested, like
         # the event engines.
-        connections = int(degree.sum())
-        stats.extra["snapshots"] = []
-        for i, b in enumerate(boundaries):
-            gen_b = int(effective_generated(schedule, b, churn).sum())
-            stats.extra["snapshots"].append(
-                {
-                    "tick": int(b),
-                    "generated": gen_b,
-                    "processed": gen_b + int(snap_received[i].sum()),
-                    "connections": connections,
-                }
-            )
+        stats.extra["snapshots"] = assemble_snapshots(
+            schedule, churn, boundaries, snap_received, degree.sum()
+        )
     return stats
 
 

@@ -26,7 +26,11 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-from p2p_gossip_tpu.engine.sync import apply_tick_updates
+from p2p_gossip_tpu.engine.sync import (
+    apply_tick_updates,
+    assemble_snapshots,
+    filter_snapshot_boundaries,
+)
 from p2p_gossip_tpu.models.churn import effective_generated, up_mask_jnp
 from p2p_gossip_tpu.models.generation import Schedule
 from p2p_gossip_tpu.models.topology import Graph
@@ -76,11 +80,17 @@ def build_sharded_runner(
     horizon: int,
     block: int = DEFAULT_DEGREE_BLOCK,
     uniform_delay: int | None = None,
+    num_snaps: int = 0,
 ):
     """Compile the per-pass runner: each shares-shard processes its own
     ``chunk_size`` shares over the row-sharded graph, from the chunk's first
     generation tick to quiescence. Memoized so repeated calls with the same
-    mesh/shapes reuse the jitted executable."""
+    mesh/shapes reuse the jitted executable.
+
+    ``num_snaps`` > 0 additionally returns (num_snaps, n_loc) received
+    counts captured when the tick counter reaches each entry of the
+    ``snap_ticks`` input — periodic-stats boundaries, same timing as the
+    sync engine (totals over all ticks strictly before the boundary)."""
     n_share_shards = mesh.shape[SHARES_AXIS]
     n_node_shards = mesh.shape[NODES_AXIS]
     n_loc = n_padded // n_node_shards
@@ -88,13 +98,13 @@ def build_sharded_runner(
 
     def pass_fn(
         ell_idx, ell_delay, ell_mask, degree, churn_start, churn_end,
-        origins, gen_ticks, t_start, last_gen,
+        origins, gen_ticks, t_start, last_gen, snap_ticks,
     ):
         # Local shapes: ell_* (n_loc, dmax); churn_* (n_loc, K) downtime
         # intervals ((n_loc, 1) zeros when churn is off — the compare is
         # vacuously up); origins/gen_ticks (chunk_size,); t_start/last_gen
         # scalars (min/max over ALL slices, so loop trip counts agree across
-        # devices).
+        # devices); snap_ticks (num_snaps,) replicated.
         row_offset = lax.axis_index(NODES_AXIS).astype(jnp.int32) * n_loc
         slots = jnp.arange(chunk_size, dtype=jnp.int32)
 
@@ -104,10 +114,11 @@ def build_sharded_runner(
             jnp.zeros((ring_size, n_padded, w), dtype=jnp.uint32),  # hist (global rows)
             jnp.zeros((n_loc,), dtype=jnp.int32),                 # received
             jnp.zeros((n_loc,), dtype=jnp.int32),                 # sent
+            jnp.zeros((num_snaps, n_loc), dtype=jnp.int32),       # snapshots
         )
 
         def cond(state):
-            t, _, hist, _, _ = state
+            t, _, hist, _, _, _ = state
             in_flight = jnp.any(hist != 0)
             # Uniform predicate across every device: OR-reduce over the mesh.
             in_flight = lax.psum(
@@ -116,7 +127,11 @@ def build_sharded_runner(
             return (t < horizon) & (in_flight | (t <= last_gen))
 
         def body(state):
-            t, seen, hist, received, sent = state
+            t, seen, hist, received, sent, snaps = state
+            if num_snaps:
+                snaps = jnp.where(
+                    (snap_ticks == t)[:, None], received[None, :], snaps
+                )
             if uniform_delay is not None:
                 arrivals = propagate_uniform(
                     hist, t, ell_idx, ell_mask,
@@ -151,13 +166,18 @@ def build_sharded_runner(
             # The frontier exchange: local newly -> global rows, over ICI.
             newly_full = lax.all_gather(newly_out, NODES_AXIS, axis=0, tiled=True)
             hist = hist.at[jnp.mod(t, ring_size)].set(newly_full)
-            return (t + 1, seen, hist, received, sent)
+            return (t + 1, seen, hist, received, sent, snaps)
 
-        _, seen, _, received, sent = lax.while_loop(cond, body, state)
+        t, seen, _, received, sent, snaps = lax.while_loop(cond, body, state)
+        if num_snaps:
+            # Boundaries at/after quiescence see the (unchanging) final
+            # counts — same convention as the sync engine.
+            snaps = jnp.where((snap_ticks >= t)[:, None], received[None, :], snaps)
         # Fold the independent share slices: counters add across SHARES_AXIS.
         received = lax.psum(received, SHARES_AXIS)
         sent = lax.psum(sent, SHARES_AXIS)
-        return received, sent
+        snaps = lax.psum(snaps, SHARES_AXIS)
+        return received, sent, snaps
 
     mapped = shard_map(
         pass_fn,
@@ -173,8 +193,9 @@ def build_sharded_runner(
             P(SHARES_AXIS),       # gen_ticks
             P(),                  # t_start
             P(),                  # last_gen
+            P(),                  # snap_ticks
         ),
-        out_specs=(P(NODES_AXIS), P(NODES_AXIS)),
+        out_specs=(P(NODES_AXIS), P(NODES_AXIS), P(None, NODES_AXIS)),
         check_vma=False,
     )
     return jax.jit(mapped), n_share_shards * chunk_size
@@ -190,10 +211,13 @@ def run_sharded_sim(
     chunk_size: int = 4096,
     block: int | None = None,
     churn=None,
+    snapshot_ticks: list[int] | None = None,
 ) -> NodeStats:
     """Drop-in counterpart of run_sync_sim/run_event_sim on a device mesh:
     identical per-node counters, any number of shares — including under a
-    `models.churn.ChurnModel` (intervals shard with their node rows).
+    `models.churn.ChurnModel` (intervals shard with their node rows) and
+    with ``snapshot_ticks`` periodic-stats boundaries (identical snapshot
+    values to the other engines; see run_sync_sim).
 
     ``chunk_size`` is per share-shard. The 4096 default keeps the bitmask
     minor dimension at the TPU's full 128-lane tile width — narrower chunks
@@ -216,12 +240,16 @@ def run_sharded_sim(
     else:
         churn_start = np.zeros((n_padded, 1), dtype=np.int32)
         churn_end = np.zeros((n_padded, 1), dtype=np.int32)
+    boundaries = filter_snapshot_boundaries(snapshot_ticks, horizon_ticks)
+    snap_ticks_arr = np.asarray(boundaries, dtype=np.int32)
     runner, pass_size = build_sharded_runner(
-        mesh, n_padded, ring, chunk_size, horizon_ticks, block, uniform
+        mesh, n_padded, ring, chunk_size, horizon_ticks, block, uniform,
+        len(boundaries),
     )
 
     received = np.zeros(n_padded, dtype=np.int64)
     sent = np.zeros(n_padded, dtype=np.int64)
+    snap_received = np.zeros((len(boundaries), n_padded), dtype=np.int64)
     for chunk in schedule.chunk(pass_size):
         live = chunk.gen_ticks < horizon_ticks
         if not live.any():
@@ -229,17 +257,19 @@ def run_sharded_sim(
         origins, gen_ticks = chunk.padded(pass_size, horizon_ticks)
         t_start = np.int32(chunk.gen_ticks[live].min())
         last_gen = np.int32(chunk.gen_ticks[live].max())
-        r, s = runner(
+        r, s, sn = runner(
             ell_idx, ell_delay, ell_mask, degree, churn_start, churn_end,
-            origins, gen_ticks, t_start, last_gen,
+            origins, gen_ticks, t_start, last_gen, snap_ticks_arr,
         )
         received += np.asarray(r, dtype=np.int64)
         sent += np.asarray(s, dtype=np.int64)
+        if boundaries:
+            snap_received += np.asarray(sn, dtype=np.int64)
 
     received = received[: graph.n]
     sent = sent[: graph.n]
     generated = effective_generated(schedule, horizon_ticks, churn)
-    return NodeStats(
+    stats = NodeStats(
         generated=generated,
         received=received,
         forwarded=received.copy(),
@@ -247,3 +277,9 @@ def run_sharded_sim(
         processed=generated + received,
         degree=graph.degree.astype(np.int64),
     )
+    if snapshot_ticks is not None:
+        stats.extra["snapshots"] = assemble_snapshots(
+            schedule, churn, boundaries, snap_received[:, : graph.n],
+            stats.degree.sum(),
+        )
+    return stats

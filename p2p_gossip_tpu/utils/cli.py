@@ -418,12 +418,6 @@ def run(argv=None) -> int:
         from p2p_gossip_tpu.parallel.engine_sharded import run_sharded_sim
         from p2p_gossip_tpu.parallel.mesh import make_mesh
 
-        if snapshot_ticks:
-            print(
-                "warning: periodic stats are not supported on --backend "
-                "sharded; only final statistics will be printed",
-                file=sys.stderr,
-            )
         mesh = make_mesh(args.meshNodes or None, args.meshShares)
         print(
             f"Mesh: {mesh.shape['shares']} share-shards x "
@@ -432,7 +426,7 @@ def run(argv=None) -> int:
         stats = run_sharded_sim(
             g, sched, horizon, mesh, ell_delays=delays,
             chunk_size=args.chunkSize, block=args.degreeBlock or None,
-            churn=churn,
+            churn=churn, snapshot_ticks=snapshot_ticks,
         )
     elif args.backend == "native":
         from p2p_gossip_tpu.runtime.native import run_native_sim

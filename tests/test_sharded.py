@@ -71,3 +71,21 @@ def test_sharded_multiple_passes():
     ev = run_event_sim(g, sched, 3000)
     sh = run_sharded_sim(g, sched, 3000, _cpu_mesh(2, 2), chunk_size=32)
     assert sh.equal_counts(ev)
+
+
+def test_sharded_snapshots_match_event_engine():
+    """Periodic-stats snapshots on the sharded engine are identical to the
+    event oracle's (PrintPeriodicStats timing: totals strictly before the
+    boundary), including boundaries past quiescence."""
+    g = pg.erdos_renyi(64, 0.08, seed=5)
+    sched = pg.uniform_renewal_schedule(64, sim_time=6.0, tick_dt=0.01, seed=5)
+    boundaries = [100, 250, 400, 5000]
+    ev = run_event_sim(g, sched, 600, snapshot_ticks=boundaries)
+    sh = run_sharded_sim(
+        g, sched, 600, _cpu_mesh(4, 2), chunk_size=64,
+        snapshot_ticks=boundaries,
+    )
+    assert np.array_equal(ev.received, sh.received)
+    # 5000 > horizon: dropped by both engines.
+    assert len(ev.extra["snapshots"]) == 3
+    assert ev.extra["snapshots"] == sh.extra["snapshots"]
