@@ -36,6 +36,25 @@ inline uint64_t payload(bool gen, int64_t node, int64_t share) {
          static_cast<uint32_t>(share);
 }
 
+// Per-link loss coin — the exact uint32 spec of models/linkloss.py (xor of
+// keyed multiplies, splitmix32 finalizer). A message crossing directed link
+// (src -> dst) with arrival tick t is dropped iff the coin fires; the same
+// pure function runs in numpy/jnp on the other engines, so counters stay
+// bit-identical under a *random* loss process.
+inline bool loss_drop(int64_t src, int64_t dst, int64_t t,
+                      int64_t threshold, uint32_t seed) {
+  if (threshold <= 0) return false;
+  uint32_t h = seed ^ (static_cast<uint32_t>(src) * 0x9E3779B1u) ^
+               (static_cast<uint32_t>(dst) * 0x85EBCA77u) ^
+               (static_cast<uint32_t>(t) * 0xC2B2AE3Du);
+  h ^= h >> 16;
+  h *= 0x7FEB352Du;
+  h ^= h >> 15;
+  h *= 0x846CA68Bu;
+  h ^= h >> 16;
+  return h <= static_cast<uint32_t>(threshold - 1);
+}
+
 struct SeenSet {
   // Flat (n x words) bitset: the per-node processedShares set (p2pnode.h:38).
   std::vector<uint64_t> bits;
@@ -59,7 +78,7 @@ extern "C" {
 // Bump whenever any exported signature changes. runtime/native.py refuses a
 // library whose version doesn't match (a stale .so bound with the wrong
 // argument layout would corrupt memory) and falls back to the Python engine.
-int64_t gossip_abi_version() { return 2; }
+int64_t gossip_abi_version() { return 3; }
 
 // Runs the event-driven simulation. Returns the number of events processed
 // (heap pops), the metric NS-3-style engines are measured by. Snapshot
@@ -72,11 +91,16 @@ int64_t gossip_abi_version() { return 2; }
 // at a down node is popped (counted in the return value, like the Python
 // engine) but has no effect: generations are skipped, arrivals are lost
 // without entering the seen-set.
+//
+// Link loss (models/linkloss.py semantics): loss_threshold > 0 enables the
+// per-link erasure coin above; a dropped message never enters the heap (the
+// sender's `sent` already counted it).
 int64_t gossip_run_event_sim(
     int64_t n, const int64_t* indptr, const int32_t* indices,
     const int32_t* csr_delays, int64_t num_shares, const int32_t* origins,
     const int32_t* gen_ticks, int64_t horizon,
     int64_t churn_k, const int32_t* churn_start, const int32_t* churn_end,
+    int64_t loss_threshold, int64_t loss_seed,
     int64_t num_snapshots, const int64_t* snapshot_ticks,
     int64_t* snap_generated, int64_t* snap_processed,
     int64_t* out_generated, int64_t* out_received, int64_t* out_sent) {
@@ -104,14 +128,15 @@ int64_t gossip_run_event_sim(
     }
   };
 
+  const uint32_t lseed = static_cast<uint32_t>(loss_seed);
   auto broadcast = [&](int64_t node, int64_t share, int64_t now) {
     const int64_t lo = indptr[node], hi = indptr[node + 1];
     out_sent[node] += hi - lo;
     for (int64_t e = lo; e < hi; ++e) {
       const int64_t t_arr = now + csr_delays[e];
-      if (t_arr < horizon) {
-        heap.emplace(t_arr, payload(false, indices[e], share));
-      }
+      if (t_arr >= horizon) continue;
+      if (loss_drop(node, indices[e], t_arr, loss_threshold, lseed)) continue;
+      heap.emplace(t_arr, payload(false, indices[e], share));
     }
   };
 

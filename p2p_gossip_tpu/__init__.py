@@ -28,6 +28,7 @@ from p2p_gossip_tpu.models.topology import (
     grid_graph,
 )
 from p2p_gossip_tpu.models.generation import uniform_renewal_schedule, poisson_schedule, Schedule
+from p2p_gossip_tpu.models.linkloss import LinkLossModel
 from p2p_gossip_tpu.utils.stats import NodeStats
 
 __version__ = "0.1.0"
@@ -43,5 +44,6 @@ __all__ = [
     "Schedule",
     "uniform_renewal_schedule",
     "poisson_schedule",
+    "LinkLossModel",
     "NodeStats",
 ]

@@ -44,6 +44,7 @@ def run_event_sim(
     coverage_slots: int | None = None,
     snapshot_ticks: list[int] | None = None,
     churn=None,
+    loss=None,
 ) -> NodeStats:
     """Run the event-driven gossip simulation for ``horizon_ticks`` ticks.
 
@@ -54,6 +55,12 @@ def run_event_sim(
     whose origin is down is skipped outright, and a message arriving at a
     down node is lost (dropped, NOT marked seen — a later copy can still be
     delivered). Identical counters to the sync engine under the same model.
+
+    ``loss`` is an optional `models.linkloss.LinkLossModel`: a message
+    crossing link (u -> v) with arrival tick t is dropped in flight iff
+    the model's counter-based coin fires for (u, v, t) — the sender's
+    ``sent`` still counts. Same coins, hence identical counters, on the
+    sync/sharded engines.
 
     Returns per-node counters; if ``coverage_slots`` is set, also records each
     listed share's first-arrival tick per node in ``stats.extra``.
@@ -91,15 +98,29 @@ def run_event_sim(
             seq += 1
     heapq.heapify(heap)
 
+    if loss is not None:
+        from p2p_gossip_tpu.models.linkloss import drop_mask_np
+
+        loss_threshold, loss_seed = loss.static_cfg
+
     def broadcast(node: int, share: int, now: int) -> None:
         nonlocal seq
         lo, hi = indptr[node], indptr[node + 1]
         sent[node] += hi - lo
-        for e in range(lo, hi):
+        if loss is not None:
+            # One vectorized coin evaluation per broadcast, not per edge.
+            dropped = drop_mask_np(
+                node, indices[lo:hi], now + csr_delays[lo:hi],
+                loss_threshold, loss_seed,
+            )
+        for k, e in enumerate(range(lo, hi)):
             t_arr = now + int(csr_delays[e])
-            if t_arr < horizon_ticks:
-                heapq.heappush(heap, (t_arr, seq, 1, int(indices[e]), share))
-                seq += 1
+            if t_arr >= horizon_ticks:
+                continue
+            if loss is not None and dropped[k]:
+                continue
+            heapq.heappush(heap, (t_arr, seq, 1, int(indices[e]), share))
+            seq += 1
 
     # Periodic-stats snapshots (PrintPeriodicStats, p2pnetwork.cc:231):
     # totals captured the moment simulated time crosses each boundary.

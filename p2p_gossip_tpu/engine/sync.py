@@ -218,7 +218,8 @@ def apply_tick_updates(seen, arrivals, gen_bits, gen_cnt, received, sent, degree
 
 
 def _tick_body(
-    dg: DeviceGraph, block: int, state, origins, slots, gen_ticks, churn=None
+    dg: DeviceGraph, block: int, state, origins, slots, gen_ticks, churn=None,
+    loss=None,
 ):
     """One synchronous tick. state = (t, seen, hist, received, sent).
 
@@ -226,6 +227,10 @@ def _tick_body(
     interval arrays (models/churn.py): a down node's arrivals are lost
     (never enter ``seen``) and its generations are skipped, which zeroes
     its forward/send contribution for the tick automatically.
+
+    ``loss`` is an optional static (threshold, seed) pair — the per-link
+    erasure model (models/linkloss.py), applied edge-wise inside the
+    gather before the OR-reduce.
     """
     t, seen, hist, received, sent = state
     n, w = seen.shape
@@ -233,16 +238,18 @@ def _tick_body(
         arrivals = propagate_bucketed(
             hist, t, dg.buckets, n_out=n,
             ring_size=dg.ring_size, uniform_delay=dg.uniform_delay, block=block,
+            loss=loss,
         )
     elif dg.uniform_delay is not None:
         arrivals = propagate_uniform(
             hist, t, dg.ell_idx, dg.ell_mask,
             ring_size=dg.ring_size, uniform_delay=dg.uniform_delay, block=block,
+            loss=loss,
         )
     else:
         arrivals = propagate(
             hist, t, dg.ell_idx, dg.ell_delay, dg.ell_mask,
-            ring_size=dg.ring_size, block=block,
+            ring_size=dg.ring_size, block=block, loss=loss,
         )
     gen_active = gen_ticks == t
     if churn is not None:
@@ -263,7 +270,7 @@ def _tick_body(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("chunk_size", "horizon", "block")
+    jax.jit, static_argnames=("chunk_size", "horizon", "block", "loss")
 )
 def _run_chunk_while(
     dg: DeviceGraph,
@@ -277,6 +284,7 @@ def _run_chunk_while(
     chunk_size: int,
     horizon: int,
     block: int,
+    loss: tuple | None = None,
 ):
     """Run one share chunk to quiescence (or the horizon) under while_loop.
 
@@ -311,7 +319,7 @@ def _run_chunk_while(
             )
         t, seen, hist, received, sent = _tick_body(
             dg, block, (t, seen, hist, received, sent), origins, slots,
-            gen_ticks, churn,
+            gen_ticks, churn, loss,
         )
         return (t, seen, hist, received, sent, snaps)
 
@@ -325,7 +333,8 @@ def _run_chunk_while(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "chunk_size", "horizon", "block", "use_pallas", "coverage_slots"
+        "chunk_size", "horizon", "block", "use_pallas", "coverage_slots",
+        "loss",
     ),
 )
 def _run_chunk_coverage(
@@ -339,6 +348,7 @@ def _run_chunk_coverage(
     block: int,
     use_pallas: bool = False,
     coverage_slots: int | None = None,
+    loss: tuple | None = None,
 ):
     """Coverage-recording run from t=0 — drives the time-to-coverage
     metrics. Returns per-tick coverage (horizon, S) but exits the tick loop
@@ -379,7 +389,7 @@ def _run_chunk_coverage(
         t, seen, hist, received, sent, cov_hist = full_state
         state = _tick_body(
             dg, block, (t, seen, hist, received, sent), origins, slots,
-            gen_ticks, churn,
+            gen_ticks, churn, loss,
         )
         cov_hist = jax.lax.dynamic_update_slice(
             cov_hist, coverage_of(state[1])[None], (t, 0)
@@ -409,6 +419,7 @@ def run_sync_sim(
     stop_after_chunks: int | None = None,
     churn=None,
     snapshot_ticks: list[int] | None = None,
+    loss=None,
 ) -> NodeStats:
     """Run the full simulation on the synchronous engine.
 
@@ -431,9 +442,15 @@ def run_sync_sim(
     (PrintPeriodicStats, p2pnetwork.cc:231): ``stats.extra["snapshots"]``
     gets one entry per boundary with the totals over all ticks strictly
     before it — identical values to the event engines' snapshots.
+
+    ``loss`` is an optional `models.linkloss.LinkLossModel`: messages
+    crossing a directed link during one of its erasure ticks are dropped
+    in flight (sender still counts the send). Deterministic — identical
+    counters on the event engines under the same model.
     """
     dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
     block = _resolve_block(dg, block)
+    loss_cfg = loss.static_cfg if loss is not None else None
     churn_dev = churn_to_device(churn)
     chunk_size = min(chunk_size, max(MIN_CHUNK_SHARES, schedule.num_shares))
     # Round chunk size up to whole words.
@@ -461,6 +478,9 @@ def run_sync_sim(
             _canonical_delays(dg), dg.uniform_delay, dg.ring_size,
             churn.down_start if churn is not None else None,
             churn.down_end if churn is not None else None,
+            # Loss model (appended only when on, preserving pre-existing
+            # fingerprints of loss-free runs).
+            *([np.asarray(loss_cfg, dtype=np.int64)] if loss_cfg else []),
             # Appended only when snapshots are on, so checkpoints from
             # snapshot-free runs keep their pre-existing fingerprints.
             *([np.asarray(boundaries, dtype=np.int64)] if boundaries else []),
@@ -527,6 +547,7 @@ def run_sync_sim(
                 dg, jnp.asarray(origins), jnp.asarray(gen_ticks), t_start,
                 last_gen, churn_dev, snap_ticks_dev,
                 chunk_size=chunk_size, horizon=horizon_ticks, block=block,
+                loss=loss_cfg,
             )
             received += np.asarray(r, dtype=np.int64)
             sent += np.asarray(s, dtype=np.int64)
@@ -568,6 +589,7 @@ def run_flood_coverage(
     block: int | None = None,
     device_graph: DeviceGraph | None = None,
     churn=None,
+    loss=None,
 ):
     """Flood coverage-time experiment: one share per origin, all at t=0.
 
@@ -586,10 +608,11 @@ def run_flood_coverage(
     # even though a TPU plugin is registered).
     use_pallas = any(d.platform == "tpu" for d in dg.ell_idx.devices())
     churn_dev = churn_to_device(churn)
+    loss_cfg = loss.static_cfg if loss is not None else None
     _, r, snt, cov = _run_chunk_coverage(
         dg, jnp.asarray(o), jnp.asarray(g), churn_dev,
         chunk_size=chunk_size, horizon=horizon_ticks, block=block,
-        use_pallas=use_pallas, coverage_slots=s,
+        use_pallas=use_pallas, coverage_slots=s, loss=loss_cfg,
     )
     generated = effective_generated(sched, horizon_ticks, churn)
     received = np.asarray(r, dtype=np.int64)

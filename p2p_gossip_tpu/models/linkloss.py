@@ -1,0 +1,104 @@
+"""Per-link message-loss model — deterministic across every engine.
+
+Beyond-reference capability (the reference's TCP links never drop,
+p2pnode.cc:129-141): each directed link (src -> dst) suffers an erasure at
+a given arrival tick with probability ``prob``, dropping ALL messages
+crossing it that tick (tick-granular burst loss, the link-level analogue of
+node churn in models/churn.py). The sender still counts its sends — loss
+happens in flight — so the reference's counter laws become
+``sent == (generated + forwarded) * degree`` (unchanged) with
+``received`` counting only successful first-time deliveries; full coverage
+is no longer guaranteed.
+
+The coin is a counter-based hash, not sampled state: ``drop(src, dst, t)``
+is a pure function of the directed edge, the arrival tick, and the model
+seed. All four engines — Python event, native C++, sync TPU, sharded TPU —
+evaluate the same uint32 spec below and therefore agree bit-for-bit on
+which messages are lost, which is what makes cross-engine counter-parity
+tests possible for a *random* loss process.
+
+Spec (all arithmetic mod 2^32; splitmix32 finalizer):
+
+    h0   = seed ^ (src * 0x9E3779B1) ^ (dst * 0x85EBCA77) ^ (t * 0xC2B2AE3D)
+    h    = mix32(h0)  where  mix32: h ^= h>>16; h *= 0x7FEB352D;
+                              h ^= h>>15; h *= 0x846CA68B; h ^= h>>16
+    drop iff h <= threshold - 1   (threshold = round(prob * 2^32); 0 = off)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+_C_SRC = 0x9E3779B1
+_C_DST = 0x85EBCA77
+_C_TICK = 0xC2B2AE3D
+_M1 = 0x7FEB352D
+_M2 = 0x846CA68B
+_MASK = 0xFFFFFFFF
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkLossModel:
+    """Directed-link erasure model: ``prob`` in [0, 1], deterministic in
+    ``seed``. ``threshold`` is the uint32 acceptance bound of the spec
+    above (0 disables; 2^32 drops everything)."""
+
+    prob: float
+    seed: int = 0
+
+    def __post_init__(self):
+        if not 0.0 <= self.prob <= 1.0:
+            raise ValueError(f"loss prob must be in [0, 1], got {self.prob}")
+
+    @property
+    def threshold(self) -> int:
+        return int(round(self.prob * (1 << 32)))
+
+    @property
+    def static_cfg(self) -> tuple:
+        """The hashable (threshold, seed) pair the jit engines take as their
+        static ``loss`` parameter — the one conversion point between the
+        model and the compiled tick step."""
+        return (self.threshold, self.seed)
+
+
+def drop_mask_np(src, dst, tick, threshold: int, seed: int) -> np.ndarray:
+    """Reference (numpy) evaluation of the spec; shapes broadcast."""
+    h = (
+        np.uint64(seed & _MASK)
+        ^ (np.asarray(src, np.uint64) * np.uint64(_C_SRC))
+        ^ (np.asarray(dst, np.uint64) * np.uint64(_C_DST))
+        ^ (np.asarray(tick, np.uint64) * np.uint64(_C_TICK))
+    ) & np.uint64(_MASK)
+    h ^= h >> np.uint64(16)
+    h = (h * np.uint64(_M1)) & np.uint64(_MASK)
+    h ^= h >> np.uint64(15)
+    h = (h * np.uint64(_M2)) & np.uint64(_MASK)
+    h ^= h >> np.uint64(16)
+    if threshold <= 0:
+        return np.zeros(h.shape, dtype=bool)
+    return h <= np.uint64(threshold - 1)
+
+
+def drop_mask_jnp(src, dst, tick, threshold: int, seed: int):
+    """jnp evaluation — bit-identical to drop_mask_np (uint32 wraparound
+    replaces the uint64+mask dance, which jax's default 32-bit mode can't
+    express)."""
+    import jax.numpy as jnp
+
+    h = (
+        jnp.uint32(seed & _MASK)
+        ^ (jnp.asarray(src).astype(jnp.uint32) * jnp.uint32(_C_SRC))
+        ^ (jnp.asarray(dst).astype(jnp.uint32) * jnp.uint32(_C_DST))
+        ^ (jnp.asarray(tick).astype(jnp.uint32) * jnp.uint32(_C_TICK))
+    )
+    h ^= h >> 16
+    h = h * jnp.uint32(_M1)
+    h ^= h >> 15
+    h = h * jnp.uint32(_M2)
+    h ^= h >> 16
+    if threshold <= 0:
+        return jnp.zeros(h.shape, dtype=bool)
+    return h <= jnp.uint32((threshold - 1) & _MASK)

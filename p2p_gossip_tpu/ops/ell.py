@@ -68,7 +68,18 @@ def _pad_degree_axis(arr: jnp.ndarray, block: int, fill) -> jnp.ndarray:
     return arr
 
 
-@functools.partial(jax.jit, static_argnames=("ring_size", "block"))
+def _loss_keep(b_idx, dst_ids, tick, loss):
+    """(N_out, B) bool: True where the directed link (src=b_idx -> dst) is
+    NOT suffering a loss-model erasure at arrival tick ``tick``
+    (models/linkloss.py spec). ``loss`` is the static (threshold, seed)
+    pair."""
+    from p2p_gossip_tpu.models.linkloss import drop_mask_jnp
+
+    threshold, seed = loss
+    return ~drop_mask_jnp(b_idx, dst_ids[:, None], tick, threshold, seed)
+
+
+@functools.partial(jax.jit, static_argnames=("ring_size", "block", "loss"))
 def propagate(
     hist: jnp.ndarray,      # (D, N, W) uint32 — newly-frontier history ring
     tick: jnp.ndarray,      # scalar int32 — current tick t
@@ -78,6 +89,8 @@ def propagate(
     *,
     ring_size: int,
     block: int = DEFAULT_DEGREE_BLOCK,
+    loss: tuple | None = None,
+    dst_ids: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Returns arrivals: (N_out, W) uint32 — shares arriving per tick.
 
@@ -85,11 +98,18 @@ def propagate(
     destination rows being computed. Single-device: N_out == N_src. Sharded
     engine: N_out is the local row shard while hist holds the all_gathered
     global frontier history (neighbor ids stay global).
+
+    ``loss`` = (threshold, seed) enables the per-link erasure model
+    (models/linkloss.py); ``dst_ids`` gives the global node id of each of
+    the N_out rows (defaults to 0..N_out-1 — pass explicitly whenever rows
+    are a shard or bucket of the global graph).
     """
     d, n_src, w = hist.shape
     n_out = ell_idx.shape[0]
     assert d == ring_size
     flat = hist.reshape(d * n_src, w)
+    if loss is not None and dst_ids is None:
+        dst_ids = jnp.arange(n_out, dtype=jnp.int32)
 
     idx = _pad_degree_axis(ell_idx, block, 0)
     dly = _pad_degree_axis(ell_delay, block, 1)
@@ -104,7 +124,10 @@ def propagate(
         b_idx, b_dly, b_msk = blk
         slot = jnp.mod(tick - b_dly, ring_size)
         gathered = flat[slot * n_src + b_idx]  # (N_out, B, W)
-        gathered = jnp.where(b_msk[..., None], gathered, jnp.uint32(0))
+        keep = b_msk
+        if loss is not None:
+            keep = keep & _loss_keep(b_idx, dst_ids, tick, loss)
+        gathered = jnp.where(keep[..., None], gathered, jnp.uint32(0))
         acc = acc | lax.reduce(
             gathered, jnp.uint32(0), lax.bitwise_or, (1,)
         )
@@ -116,7 +139,7 @@ def propagate(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("ring_size", "block", "uniform_delay")
+    jax.jit, static_argnames=("ring_size", "block", "uniform_delay", "loss")
 )
 def propagate_uniform(
     hist: jnp.ndarray,      # (D, N_src, W) uint32
@@ -127,16 +150,20 @@ def propagate_uniform(
     ring_size: int,
     uniform_delay: int = 1,
     block: int = DEFAULT_DEGREE_BLOCK,
+    loss: tuple | None = None,
+    dst_ids: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Fast path for a uniform per-edge delay (the reference's constant-link
     -latency model): the delay-line slot is one scalar per tick, so the
     per-edge delay gather — and the whole (N, dmax) delay array read from
-    HBM — disappears."""
+    HBM — disappears. ``loss``/``dst_ids`` as in `propagate`."""
     d, n_src, w = hist.shape
     n_out = ell_idx.shape[0]
     assert d == ring_size
     # One source frontier for the whole tick.
     src = hist[jnp.mod(tick - uniform_delay, ring_size)]  # (N_src, W)
+    if loss is not None and dst_ids is None:
+        dst_ids = jnp.arange(n_out, dtype=jnp.int32)
 
     idx = _pad_degree_axis(ell_idx, block, 0)
     msk = _pad_degree_axis(ell_mask, block, False)
@@ -147,7 +174,10 @@ def propagate_uniform(
     def body(acc, blk):
         b_idx, b_msk = blk
         gathered = src[b_idx]  # (N_out, B, W)
-        gathered = jnp.where(b_msk[..., None], gathered, jnp.uint32(0))
+        keep = b_msk
+        if loss is not None:
+            keep = keep & _loss_keep(b_idx, dst_ids, tick, loss)
+        gathered = jnp.where(keep[..., None], gathered, jnp.uint32(0))
         acc = acc | lax.reduce(gathered, jnp.uint32(0), lax.bitwise_or, (1,))
         return acc, None
 
@@ -253,12 +283,14 @@ def propagate_bucketed(
     ring_size: int,
     uniform_delay: int | None = None,
     block: int = DEFAULT_DEGREE_BLOCK,
+    loss: tuple | None = None,
 ) -> jnp.ndarray:
     """Gather-OR over degree buckets (see `build_degree_buckets`).
 
     Bitwise-identical to `propagate`/`propagate_uniform` on the full ELL —
     each bucket computes its rows' arrivals over its own (tight) ELL and the
-    results are scattered back into node order.
+    results are scattered back into node order. ``loss`` as in `propagate`
+    (each bucket's global row ids are its dst_ids).
     """
     w = hist.shape[-1]
     parts = []
@@ -270,12 +302,13 @@ def propagate_bucketed(
             part = propagate_uniform(
                 hist, tick, b_idx, b_mask,
                 ring_size=ring_size, uniform_delay=uniform_delay,
-                block=b_block,
+                block=b_block, loss=loss, dst_ids=rows if loss else None,
             )
         else:
             part = propagate(
                 hist, tick, b_idx, b_delay, b_mask,
                 ring_size=ring_size, block=b_block,
+                loss=loss, dst_ids=rows if loss else None,
             )
         parts.append(part)
     # One combined scatter back to node order (the rows arrays partition

@@ -81,6 +81,7 @@ def build_sharded_runner(
     block: int = DEFAULT_DEGREE_BLOCK,
     uniform_delay: int | None = None,
     num_snaps: int = 0,
+    loss: tuple | None = None,
 ):
     """Compile the per-pass runner: each shares-shard processes its own
     ``chunk_size`` shares over the row-sharded graph, from the chunk's first
@@ -107,6 +108,14 @@ def build_sharded_runner(
         # devices); snap_ticks (num_snaps,) replicated.
         row_offset = lax.axis_index(NODES_AXIS).astype(jnp.int32) * n_loc
         slots = jnp.arange(chunk_size, dtype=jnp.int32)
+        # Global node ids of this shard's rows — the loss coin hashes
+        # global (src, dst) pairs so every mesh shape agrees with the
+        # single-device engines.
+        dst_ids = (
+            row_offset + jnp.arange(n_loc, dtype=jnp.int32)
+            if loss is not None
+            else None
+        )
 
         state = (
             t_start,
@@ -136,12 +145,13 @@ def build_sharded_runner(
                 arrivals = propagate_uniform(
                     hist, t, ell_idx, ell_mask,
                     ring_size=ring_size, uniform_delay=uniform_delay,
-                    block=block,
+                    block=block, loss=loss, dst_ids=dst_ids,
                 )
             else:
                 arrivals = propagate(
                     hist, t, ell_idx, ell_delay, ell_mask,
                     ring_size=ring_size, block=block,
+                    loss=loss, dst_ids=dst_ids,
                 )
             up = up_mask_jnp(churn_start, churn_end, t)
             arrivals = jnp.where(up[:, None], arrivals, jnp.uint32(0))
@@ -212,10 +222,13 @@ def run_sharded_sim(
     block: int | None = None,
     churn=None,
     snapshot_ticks: list[int] | None = None,
+    loss=None,
 ) -> NodeStats:
     """Drop-in counterpart of run_sync_sim/run_event_sim on a device mesh:
     identical per-node counters, any number of shares — including under a
-    `models.churn.ChurnModel` (intervals shard with their node rows) and
+    `models.churn.ChurnModel` (intervals shard with their node rows), a
+    `models.linkloss.LinkLossModel` (the counter-based coin hashes global
+    node ids, so shard boundaries don't change which messages drop), and
     with ``snapshot_ticks`` periodic-stats boundaries (identical snapshot
     values to the other engines; see run_sync_sim).
 
@@ -245,6 +258,7 @@ def run_sharded_sim(
     runner, pass_size = build_sharded_runner(
         mesh, n_padded, ring, chunk_size, horizon_ticks, block, uniform,
         len(boundaries),
+        loss.static_cfg if loss is not None else None,
     )
 
     received = np.zeros(n_padded, dtype=np.int64)

@@ -31,7 +31,7 @@ _lib_checked = False
 # Must match gossip_abi_version() in native/gossip_native.cc. Binding a stale
 # .so with a different argument layout would scribble over the wrong buffers,
 # so a mismatch is treated as "not built".
-ABI_VERSION = 2
+ABI_VERSION = 3
 
 
 def _try_autobuild() -> None:
@@ -119,6 +119,8 @@ def _configure(lib) -> None:
         ctypes.c_int64,              # horizon
         ctypes.c_int64,              # churn_k
         i32p, i32p,                  # churn_start, churn_end (n x churn_k)
+        ctypes.c_int64,              # loss_threshold (0 = off)
+        ctypes.c_int64,              # loss_seed
         ctypes.c_int64,              # num_snapshots
         i64p, i64p, i64p,            # snapshot_ticks, snap_generated, snap_processed
         i64p, i64p, i64p,            # out: generated, received, sent
@@ -149,10 +151,11 @@ def run_native_sim(
     constant_delay: int = 1,
     snapshot_ticks: list[int] | None = None,
     churn=None,
+    loss=None,
 ) -> NodeStats:
     """Event-driven simulation on the C++ engine (counters identical to
-    `engine.event.run_event_sim`, including under a churn model). Falls back
-    to Python when unbuilt."""
+    `engine.event.run_event_sim`, including under churn and link-loss
+    models). Falls back to Python when unbuilt."""
     lib = load_library()
     if lib is None:
         warnings.warn(
@@ -162,7 +165,7 @@ def run_native_sim(
 
         return run_event_sim(
             graph, schedule, horizon_ticks, ell_delays, constant_delay,
-            snapshot_ticks=snapshot_ticks, churn=churn,
+            snapshot_ticks=snapshot_ticks, churn=churn, loss=loss,
         )
 
     n = graph.n
@@ -203,6 +206,8 @@ def run_native_sim(
         churn_k,
         churn_start,
         churn_end,
+        loss.threshold if loss is not None else 0,
+        loss.seed if loss is not None else 0,
         len(boundaries),
         np.ascontiguousarray(boundaries) if len(boundaries) else snap_gen,
         snap_gen,

@@ -111,6 +111,13 @@ def build_parser() -> argparse.ArgumentParser:
         "shares and skip generations.",
     )
     p.add_argument(
+        "--lossProb", type=float, default=0.0,
+        help="Per-link message loss probability: each directed link drops "
+        "all messages crossing it during an erasure tick with this "
+        "probability (0 disables). Deterministic in --seed; identical "
+        "counters on every backend.",
+    )
+    p.add_argument(
         "--churnDowntime", type=float, default=5.0,
         help="Mean outage duration in seconds (geometric, min one tick)",
     )
@@ -165,7 +172,7 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _run_flood_coverage_cli(args, g, horizon, delays, churn) -> int:
+def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
     """Flood coverage-time experiment (BASELINE.json headline config): S
     shares flooded from random origins at t=0, per-share
     time-to-``coverageFraction`` reported in ticks and seconds."""
@@ -177,7 +184,7 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn) -> int:
     t0 = time.perf_counter()
     stats, coverage = run_flood_coverage(
         g, origins, horizon, ell_delays=delays,
-        block=args.degreeBlock or None, churn=churn,
+        block=args.degreeBlock or None, churn=churn, loss=loss,
     )
     wall = time.perf_counter() - t0
     ttc = time_to_coverage(coverage, g.n, args.coverageFraction)
@@ -306,6 +313,19 @@ def run(argv=None) -> int:
         print("error: --degreeBlock must be >= 0", file=sys.stderr)
         return 2
 
+    loss = None
+    if not 0.0 <= args.lossProb <= 1.0:
+        print(
+            f"error: --lossProb must be in [0, 1], got {args.lossProb:g}",
+            file=sys.stderr,
+        )
+        return 2
+    if args.lossProb > 0.0:
+        from p2p_gossip_tpu.models.linkloss import LinkLossModel
+
+        # Offset seed: independent of the topology/schedule/churn streams.
+        loss = LinkLossModel(args.lossProb, seed=args.seed + 104729)
+
     churn = None
     if not 0.0 <= args.churnProb <= 1.0:
         print(
@@ -371,10 +391,13 @@ def run(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
-        return _run_flood_coverage_cli(args, g, horizon, delays, churn)
+        return _run_flood_coverage_cli(args, g, horizon, delays, churn, loss)
 
     if args.protocol == "pushpull" and args.backend != "tpu":
         print("error: --protocol pushpull requires --backend tpu", file=sys.stderr)
+        return 2
+    if loss is not None and args.protocol != "push":
+        print("error: --lossProb requires --protocol push", file=sys.stderr)
         return 2
     if churn is not None and args.protocol != "push":
         print("error: --churnProb requires --protocol push", file=sys.stderr)
@@ -413,6 +436,7 @@ def run(argv=None) -> int:
             checkpoint_every=args.checkpointEvery,
             churn=churn,
             snapshot_ticks=snapshot_ticks,
+            loss=loss,
         )
     elif args.backend == "sharded":
         from p2p_gossip_tpu.parallel.engine_sharded import run_sharded_sim
@@ -426,21 +450,21 @@ def run(argv=None) -> int:
         stats = run_sharded_sim(
             g, sched, horizon, mesh, ell_delays=delays,
             chunk_size=args.chunkSize, block=args.degreeBlock or None,
-            churn=churn, snapshot_ticks=snapshot_ticks,
+            churn=churn, snapshot_ticks=snapshot_ticks, loss=loss,
         )
     elif args.backend == "native":
         from p2p_gossip_tpu.runtime.native import run_native_sim
 
         stats = run_native_sim(
             g, sched, horizon, ell_delays=delays, snapshot_ticks=snapshot_ticks,
-            churn=churn,
+            churn=churn, loss=loss,
         )
     else:
         from p2p_gossip_tpu.engine.event import run_event_sim
 
         stats = run_event_sim(
             g, sched, horizon, ell_delays=delays, snapshot_ticks=snapshot_ticks,
-            churn=churn,
+            churn=churn, loss=loss,
         )
     wall = time.perf_counter() - t0
 
