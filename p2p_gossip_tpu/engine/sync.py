@@ -92,27 +92,40 @@ class DeviceGraph:
         """
         if bucketed is None:
             bucketed = graph.n >= 4096
-        ell_idx, ell_mask = graph.ell()
-        if ell_delays is None:
-            ell_delays = np.full(ell_idx.shape, constant_delay, dtype=np.int32)
-        dmax_delay = int(ell_delays.max()) if ell_delays.size else 1
-        uniform = detect_uniform_delay(ell_delays, ell_mask)
         placeholder = np.ones((1, 1), dtype=np.int32)
         buckets = None
-        if bucketed:
-            buckets = build_degree_buckets(
-                graph,
-                None if uniform is not None else ell_delays,
-                block=block,
-                ell=(ell_idx, ell_mask),
-            )
-            # The bucketed path never reads the full-width arrays.
+        if ell_delays is None and bucketed:
+            # Uniform delay + bucketed staging: bucket ELLs come straight
+            # from CSR (ops.ell._ell_rows_from_csr) — the (N, dmax) global
+            # ELL and its O(nnz) coordinate transients are never built
+            # (~25 GB of host memory at 1M nodes / 500M edges).
+            uniform = constant_delay
+            dmax_delay = constant_delay
+            buckets = build_degree_buckets(graph, None, block=block)
             ell_idx = ell_delays = placeholder
             ell_mask = placeholder.astype(bool)
-        elif uniform is not None:
-            # The fast path never reads per-edge delays: stage a placeholder
-            # instead of an (N, dmax) array of dead HBM.
-            ell_delays = placeholder
+        else:
+            ell_idx, ell_mask = graph.ell()
+            if ell_delays is None:
+                ell_delays = np.full(
+                    ell_idx.shape, constant_delay, dtype=np.int32
+                )
+            dmax_delay = int(ell_delays.max()) if ell_delays.size else 1
+            uniform = detect_uniform_delay(ell_delays, ell_mask)
+            if bucketed:
+                buckets = build_degree_buckets(
+                    graph,
+                    None if uniform is not None else ell_delays,
+                    block=block,
+                    ell=(ell_idx, ell_mask),
+                )
+                # The bucketed path never reads the full-width arrays.
+                ell_idx = ell_delays = placeholder
+                ell_mask = placeholder.astype(bool)
+            elif uniform is not None:
+                # The fast path never reads per-edge delays: stage a
+                # placeholder instead of an (N, dmax) array of dead HBM.
+                ell_delays = placeholder
         return DeviceGraph(
             n=graph.n,
             ell_idx=jnp.asarray(ell_idx, dtype=jnp.int32),

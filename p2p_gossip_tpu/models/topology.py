@@ -23,6 +23,7 @@ single-link values.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import numpy as np
 
@@ -48,7 +49,7 @@ class Graph:
 
     # -- derived forms -----------------------------------------------------
 
-    @property
+    @functools.cached_property
     def degree(self) -> np.ndarray:
         return np.diff(self.indptr).astype(np.int32)
 
@@ -87,6 +88,26 @@ class Graph:
         rows, pos = self.csr_rows_pos()
         ell_idx[rows, pos] = self.indices
         ell_mask[rows, pos] = True
+        return ell_idx, ell_mask
+
+    def ell_rows(self, rows: np.ndarray, pad_to: int) -> tuple[np.ndarray, np.ndarray]:
+        """ELL form of a row subset, straight from CSR: (len(rows), pad_to)
+        ``(ell_idx, ell_mask)``, bit-identical to ``self.ell()[...][rows,
+        :pad_to]`` (same CSR neighbor order, same front-packed 0-padding)
+        but without materializing the (n, dmax) global ELL — degree-bucketed
+        staging at 1M nodes / 500M edges would otherwise burn ~25 GB of
+        host transients."""
+        deg = self.degree[rows].astype(np.int64)
+        nnz = int(deg.sum())
+        rep = np.repeat(np.arange(len(rows), dtype=np.int64), deg)
+        pos = np.arange(nnz, dtype=np.int64) - np.repeat(
+            np.cumsum(deg) - deg, deg
+        )
+        src = self.indices[np.repeat(self.indptr[rows], deg) + pos]
+        ell_idx = np.zeros((len(rows), pad_to), dtype=np.int32)
+        ell_mask = np.zeros((len(rows), pad_to), dtype=bool)
+        ell_idx[rep, pos] = src
+        ell_mask[rep, pos] = True
         return ell_idx, ell_mask
 
     def edges(self) -> np.ndarray:

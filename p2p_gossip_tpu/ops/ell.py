@@ -216,7 +216,13 @@ def build_degree_buckets(
     import numpy as np
 
     deg = np.asarray(graph.degree)
-    ell_idx, ell_mask = ell if ell is not None else graph.ell()
+    # With no per-edge delays and no pre-materialized ELL, each bucket's
+    # arrays come straight from CSR (Graph.ell_rows) — the global (N, dmax)
+    # ELL is never built. Delay staging still needs the full ELL-aligned
+    # delay array, so that path keeps the global ELL.
+    if ell is None and ell_delays is not None:
+        ell = graph.ell()
+    ell_idx, ell_mask = ell if ell is not None else (None, None)
     level = (deg + block - 1) // block  # cap = level * block
     # Heavy-tailed graphs (e.g. Barabási–Albert) have hundreds of distinct
     # high-degree levels with a handful of nodes each; min_rows merging would
@@ -260,12 +266,21 @@ def build_degree_buckets(
     buckets = []
     for rows in merged:
         cap = int(level[rows].max()) * block
-        cap = max(cap, block)
+        # Geometric (power-of-two) levels can sit up to ~2x above the
+        # bucket's true max degree — clamp to it (block-rounded) so hub
+        # buckets don't gather masked padding every tick.
+        tight = -(-int(deg[rows].max()) // block) * block
+        cap = max(min(cap, tight), block)
+        if ell_idx is not None:
+            b_idx = np.ascontiguousarray(ell_idx[rows, :cap])
+            b_mask = np.ascontiguousarray(ell_mask[rows, :cap])
+        else:
+            b_idx, b_mask = graph.ell_rows(rows, cap)
         buckets.append(
             (
                 jnp.asarray(rows.astype(np.int32)),
-                jnp.asarray(np.ascontiguousarray(ell_idx[rows, :cap])),
-                jnp.asarray(np.ascontiguousarray(ell_mask[rows, :cap])),
+                jnp.asarray(b_idx),
+                jnp.asarray(b_mask),
                 jnp.asarray(np.ascontiguousarray(ell_delays[rows, :cap]))
                 if ell_delays is not None
                 else None,

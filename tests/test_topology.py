@@ -174,3 +174,19 @@ def test_torus_is_regular():
 def test_grid_validates_params():
     with pytest.raises(ValueError):
         grid_graph(1, 1)
+
+
+def test_ell_rows_matches_global_ell():
+    """Graph.ell_rows (the direct-CSR row-subset ELL used by degree-bucketed
+    staging) is bit-identical to slicing the global ELL."""
+    g = erdos_renyi(300, 0.05, seed=2)
+    full_idx, full_mask = g.ell()
+    rows = np.asarray([0, 7, 123, 299, 5])
+    cap = int(g.degree[rows].max()) + 4
+    sub_idx, sub_mask = g.ell_rows(rows, cap)
+    pad = cap - full_idx.shape[1]
+    if pad > 0:
+        full_idx = np.pad(full_idx, ((0, 0), (0, pad)))
+        full_mask = np.pad(full_mask, ((0, 0), (0, pad)))
+    assert np.array_equal(sub_idx, full_idx[rows, :cap])
+    assert np.array_equal(sub_mask, full_mask[rows, :cap])
