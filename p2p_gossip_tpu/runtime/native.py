@@ -180,7 +180,14 @@ def run_native_sim(
     sent = np.zeros(n, dtype=np.int64)
     origins = np.ascontiguousarray(schedule.origins, dtype=np.int32)
     gen_ticks = np.ascontiguousarray(schedule.gen_ticks, dtype=np.int32)
-    boundaries = np.asarray(sorted(snapshot_ticks or []), dtype=np.int64)
+    from p2p_gossip_tpu.engine.sync import filter_snapshot_boundaries
+
+    # Boundaries past the horizon never fire on the event engine; the C++
+    # loop would leave their slots zero-filled — drop them for parity.
+    boundaries = np.asarray(
+        filter_snapshot_boundaries(snapshot_ticks, horizon_ticks),
+        dtype=np.int64,
+    )
     snap_gen = np.zeros(max(len(boundaries), 1), dtype=np.int64)
     snap_proc = np.zeros(max(len(boundaries), 1), dtype=np.int64)
     if churn is not None:
