@@ -206,7 +206,9 @@ def run_event_sim(
     take_snapshots(horizon_ticks)
     log.info(f"event simulation done: {events_processed} events processed")
     stats.extra["events_processed"] = events_processed
-    if boundaries:
+    if snapshot_ticks is not None:
+        # Present (possibly empty) whenever snapshots were requested — the
+        # same key-presence convention as the sync/sharded/native engines.
         stats.extra["snapshots"] = snapshots
     if arrival_ticks is not None:
         stats.extra["arrival_ticks"] = arrival_ticks

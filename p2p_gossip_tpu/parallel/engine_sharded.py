@@ -71,6 +71,38 @@ def _padded_device_graph(
     return ell_idx, ell_delays, ell_mask, degree, ring, uniform
 
 
+def _stage_sharded_inputs(
+    graph: Graph,
+    ell_delays: np.ndarray | None,
+    constant_delay: int,
+    mesh: Mesh,
+    block: int | None,
+    churn,
+):
+    """The host-side staging shared by run_sharded_sim and
+    run_sharded_flood_coverage: padded ELL arrays, block auto-resolution
+    (the swept TPU optimum capped by the staged max degree; results are
+    bitwise-identical for any block), and churn intervals padded with
+    their node rows."""
+    n_node_shards = mesh.shape[NODES_AXIS]
+    ell_idx, ell_delay, ell_mask, degree, ring, uniform = _padded_device_graph(
+        graph, ell_delays, constant_delay, n_node_shards
+    )
+    n_padded = ell_idx.shape[0]
+    if block is None:
+        block = tuned_degree_block(ell_idx.shape[1], mesh.devices.flat)
+    if churn is not None:
+        churn_start = pad_to_multiple(churn.down_start, n_node_shards)
+        churn_end = pad_to_multiple(churn.down_end, n_node_shards)
+    else:
+        churn_start = np.zeros((n_padded, 1), dtype=np.int32)
+        churn_end = np.zeros((n_padded, 1), dtype=np.int32)
+    return (
+        ell_idx, ell_delay, ell_mask, degree, ring, uniform, n_padded,
+        block, churn_start, churn_end,
+    )
+
+
 @functools.lru_cache(maxsize=32)
 def build_sharded_runner(
     mesh: Mesh,
@@ -82,6 +114,8 @@ def build_sharded_runner(
     uniform_delay: int | None = None,
     num_snaps: int = 0,
     loss: tuple | None = None,
+    record_coverage: bool = False,
+    cov_slots: int | None = None,
 ):
     """Compile the per-pass runner: each shares-shard processes its own
     ``chunk_size`` shares over the row-sharded graph, from the chunk's first
@@ -91,11 +125,25 @@ def build_sharded_runner(
     ``num_snaps`` > 0 additionally returns (num_snaps, n_loc) received
     counts captured when the tick counter reaches each entry of the
     ``snap_ticks`` input — periodic-stats boundaries, same timing as the
-    sync engine (totals over all ticks strictly before the boundary)."""
+    sync engine (totals over all ticks strictly before the boundary).
+
+    ``record_coverage`` additionally returns per-tick per-slot coverage
+    (horizon, cov_slots) for the first ``cov_slots`` of this shard's share
+    slots (default: all chunk_size; the flood driver restricts it to the
+    live slots so dead padding isn't counted every tick) — node counts
+    psum'ed over the nodes axis each tick, rows past quiescence holding
+    the final (constant) coverage, exactly like the sync engine's
+    coverage runs."""
     n_share_shards = mesh.shape[SHARES_AXIS]
     n_node_shards = mesh.shape[NODES_AXIS]
     n_loc = n_padded // n_node_shards
     w = bitmask.num_words(chunk_size)
+    if cov_slots is None:
+        cov_slots = chunk_size
+    cov_w = bitmask.num_words(cov_slots)
+
+    def local_coverage(seen):
+        return bitmask.coverage_per_slot(seen[:, :cov_w], cov_slots)
 
     def pass_fn(
         ell_idx, ell_delay, ell_mask, degree, churn_start, churn_end,
@@ -124,10 +172,15 @@ def build_sharded_runner(
             jnp.zeros((n_loc,), dtype=jnp.int32),                 # received
             jnp.zeros((n_loc,), dtype=jnp.int32),                 # sent
             jnp.zeros((num_snaps, n_loc), dtype=jnp.int32),       # snapshots
+            jnp.zeros(
+                (horizon if record_coverage else 0,
+                 cov_slots if record_coverage else 0),
+                dtype=jnp.int32,
+            ),                                                    # coverage
         )
 
         def cond(state):
-            t, _, hist, _, _, _ = state
+            t, _, hist, _, _, _, _ = state
             in_flight = jnp.any(hist != 0)
             # Uniform predicate across every device: OR-reduce over the mesh.
             in_flight = lax.psum(
@@ -136,7 +189,7 @@ def build_sharded_runner(
             return (t < horizon) & (in_flight | (t <= last_gen))
 
         def body(state):
-            t, seen, hist, received, sent, snaps = state
+            t, seen, hist, received, sent, snaps, cov_hist = state
             if num_snaps:
                 snaps = jnp.where(
                     (snap_ticks == t)[:, None], received[None, :], snaps
@@ -176,9 +229,20 @@ def build_sharded_runner(
             # The frontier exchange: local newly -> global rows, over ICI.
             newly_full = lax.all_gather(newly_out, NODES_AXIS, axis=0, tiled=True)
             hist = hist.at[jnp.mod(t, ring_size)].set(newly_full)
-            return (t + 1, seen, hist, received, sent, snaps)
+            if record_coverage:
+                cov = lax.psum(local_coverage(seen), NODES_AXIS)
+                cov_hist = lax.dynamic_update_slice(cov_hist, cov[None], (t, 0))
+            return (t + 1, seen, hist, received, sent, snaps, cov_hist)
 
-        t, seen, _, received, sent, snaps = lax.while_loop(cond, body, state)
+        t, seen, _, received, sent, snaps, cov_hist = lax.while_loop(
+            cond, body, state
+        )
+        if record_coverage:
+            # Rows past quiescence hold the (monotone, now constant) final
+            # coverage — same convention as the sync engine.
+            final = lax.psum(local_coverage(seen), NODES_AXIS)
+            ticks = jnp.arange(horizon, dtype=jnp.int32)[:, None]
+            cov_hist = jnp.where(ticks >= t, final[None, :], cov_hist)
         if num_snaps:
             # Boundaries at/after quiescence see the (unchanging) final
             # counts — same convention as the sync engine.
@@ -187,7 +251,7 @@ def build_sharded_runner(
         received = lax.psum(received, SHARES_AXIS)
         sent = lax.psum(sent, SHARES_AXIS)
         snaps = lax.psum(snaps, SHARES_AXIS)
-        return received, sent, snaps
+        return received, sent, snaps, cov_hist
 
     mapped = shard_map(
         pass_fn,
@@ -205,7 +269,10 @@ def build_sharded_runner(
             P(),                  # last_gen
             P(),                  # snap_ticks
         ),
-        out_specs=(P(NODES_AXIS), P(NODES_AXIS), P(None, NODES_AXIS)),
+        out_specs=(
+            P(NODES_AXIS), P(NODES_AXIS), P(None, NODES_AXIS),
+            P(None, SHARES_AXIS),
+        ),
         check_vma=False,
     )
     return jax.jit(mapped), n_share_shards * chunk_size
@@ -237,22 +304,11 @@ def run_sharded_sim(
     demote the hot gather to a measured ~15x slower path (see
     engine.sync.MIN_CHUNK_SHARES); tests use small chunks on CPU where only
     chunking semantics matter."""
-    n_node_shards = mesh.shape[NODES_AXIS]
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
-    ell_idx, ell_delay, ell_mask, degree, ring, uniform = _padded_device_graph(
-        graph, ell_delays, constant_delay, n_node_shards
+    (ell_idx, ell_delay, ell_mask, degree, ring, uniform, n_padded, block,
+     churn_start, churn_end) = _stage_sharded_inputs(
+        graph, ell_delays, constant_delay, mesh, block, churn
     )
-    n_padded = ell_idx.shape[0]
-    if block is None:
-        # Auto: the swept TPU optimum capped by the staged max degree
-        # (bitwise-identical results for any block; perf only).
-        block = tuned_degree_block(ell_idx.shape[1], mesh.devices.flat)
-    if churn is not None:
-        churn_start = pad_to_multiple(churn.down_start, n_node_shards)
-        churn_end = pad_to_multiple(churn.down_end, n_node_shards)
-    else:
-        churn_start = np.zeros((n_padded, 1), dtype=np.int32)
-        churn_end = np.zeros((n_padded, 1), dtype=np.int32)
     boundaries = filter_snapshot_boundaries(snapshot_ticks, horizon_ticks)
     snap_ticks_arr = np.asarray(boundaries, dtype=np.int32)
     runner, pass_size = build_sharded_runner(
@@ -271,7 +327,7 @@ def run_sharded_sim(
         origins, gen_ticks = chunk.padded(pass_size, horizon_ticks)
         t_start = np.int32(chunk.gen_ticks[live].min())
         last_gen = np.int32(chunk.gen_ticks[live].max())
-        r, s, sn = runner(
+        r, s, sn, _ = runner(
             ell_idx, ell_delay, ell_mask, degree, churn_start, churn_end,
             origins, gen_ticks, t_start, last_gen, snap_ticks_arr,
         )
@@ -297,3 +353,69 @@ def run_sharded_sim(
             stats.degree.sum(),
         )
     return stats
+
+
+def run_sharded_flood_coverage(
+    graph: Graph,
+    origins,
+    horizon_ticks: int,
+    mesh: Mesh,
+    ell_delays: np.ndarray | None = None,
+    constant_delay: int = 1,
+    chunk_size: int = 4096,
+    block: int | None = None,
+    churn=None,
+    loss=None,
+):
+    """Flood coverage-time experiment on the device mesh — the BASELINE
+    north-star metric (time-to-99% coverage at 1M nodes on a v5e-8 mesh)
+    with the same contract as `engine.sync.run_flood_coverage`: one share
+    per origin at t=0, returns (stats, (horizon, num_origins) per-tick node
+    counts). Coverage values are identical to the single-device run for
+    every mesh shape (the per-tick count psums over node shards)."""
+    origins = np.asarray(origins, dtype=np.int32).reshape(-1)
+    s = origins.shape[0]
+    n_share_shards = mesh.shape[SHARES_AXIS]
+    # One pass: pad per-shard chunks so all origins fit in a single pass.
+    per_shard = -(-s // n_share_shards)
+    chunk_size = bitmask.num_words(max(per_shard, chunk_size)) * bitmask.WORD_BITS
+    # Record only the live slots: at most min(s, chunk_size) per shard
+    # (shard k holds global slots [k*chunk, (k+1)*chunk)); counting the
+    # dead padding every tick would cost up to chunk_size/s extra work.
+    cov_slots = bitmask.num_words(min(s, chunk_size)) * bitmask.WORD_BITS
+    sched = Schedule(graph.n, origins, np.zeros(s, dtype=np.int32))
+
+    (ell_idx, ell_delay, ell_mask, degree, ring, uniform, n_padded, block,
+     churn_start, churn_end) = _stage_sharded_inputs(
+        graph, ell_delays, constant_delay, mesh, block, churn
+    )
+    runner, pass_size = build_sharded_runner(
+        mesh, n_padded, ring, chunk_size, horizon_ticks, block, uniform,
+        0, loss.static_cfg if loss is not None else None, True, cov_slots,
+    )
+    o, g_ticks = sched.padded(pass_size, horizon_ticks)
+    r, snt, _, cov = runner(
+        ell_idx, ell_delay, ell_mask, degree, churn_start, churn_end,
+        o, g_ticks, np.int32(0), np.int32(0),
+        np.zeros((0,), dtype=np.int32),
+    )
+    generated = effective_generated(sched, horizon_ticks, churn)
+    received = np.asarray(r, dtype=np.int64)[: graph.n]
+    stats = NodeStats(
+        generated=generated,
+        received=received,
+        forwarded=received.copy(),
+        sent=np.asarray(snt, dtype=np.int64)[: graph.n],
+        processed=generated + received,
+        degree=graph.degree.astype(np.int64),
+    )
+    # Reassemble global slot order: shard k recorded its first cov_slots
+    # local slots = global slots [k*chunk, k*chunk + cov_slots).
+    cov = np.asarray(cov)
+    parts = []
+    for k in range(n_share_shards):
+        live_k = min(max(s - k * chunk_size, 0), chunk_size)
+        parts.append(cov[:, k * cov_slots : k * cov_slots + live_k])
+    coverage = np.concatenate(parts, axis=1)
+    stats.extra["coverage"] = coverage
+    return stats, coverage

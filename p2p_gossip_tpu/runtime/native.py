@@ -232,7 +232,9 @@ def run_native_sim(
         degree=graph.degree.astype(np.int64),
     )
     stats.extra["events_processed"] = int(events)
-    if len(boundaries):
+    # Present (possibly empty) whenever snapshots were requested — the
+    # event/sync engines set the key even when every boundary is filtered.
+    if snapshot_ticks is not None:
         connections = int(graph.degree.sum())
         stats.extra["snapshots"] = [
             {

@@ -157,7 +157,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--floodCoverage", type=int, default=0, metavar="S",
         help="Coverage-time experiment instead of the gossip run: flood S "
         "shares from random origins at t=0 and report per-share "
-        "time-to-99%%-coverage (tpu backend only)",
+        "time-to-99%%-coverage (tpu and sharded backends)",
     )
     p.add_argument(
         "--coverageFraction", type=float, default=0.99,
@@ -175,17 +175,36 @@ def build_parser() -> argparse.ArgumentParser:
 def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
     """Flood coverage-time experiment (BASELINE.json headline config): S
     shares flooded from random origins at t=0, per-share
-    time-to-``coverageFraction`` reported in ticks and seconds."""
+    time-to-``coverageFraction`` reported in ticks and seconds. Runs on
+    the single-device sync engine or, with --backend sharded, over the
+    device mesh (identical coverage values)."""
     from p2p_gossip_tpu.engine.sync import run_flood_coverage, time_to_coverage
 
     tick_dt = args.Latency / 1000.0
     rng = np.random.default_rng(args.seed)
     origins = rng.integers(0, g.n, args.floodCoverage).astype(np.int32)
     t0 = time.perf_counter()
-    stats, coverage = run_flood_coverage(
-        g, origins, horizon, ell_delays=delays,
-        block=args.degreeBlock or None, churn=churn, loss=loss,
-    )
+    if args.backend == "sharded":
+        from p2p_gossip_tpu.parallel.engine_sharded import (
+            run_sharded_flood_coverage,
+        )
+        from p2p_gossip_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(args.meshNodes or None, args.meshShares)
+        print(
+            f"Mesh: {mesh.shape['shares']} share-shards x "
+            f"{mesh.shape['nodes']} node-shards"
+        )
+        stats, coverage = run_sharded_flood_coverage(
+            g, origins, horizon, mesh, ell_delays=delays,
+            chunk_size=args.chunkSize, block=args.degreeBlock or None,
+            churn=churn, loss=loss,
+        )
+    else:
+        stats, coverage = run_flood_coverage(
+            g, origins, horizon, ell_delays=delays,
+            block=args.degreeBlock or None, churn=churn, loss=loss,
+        )
     wall = time.perf_counter() - t0
     ttc = time_to_coverage(coverage, g.n, args.coverageFraction)
     reached = ttc >= 0
@@ -312,6 +331,14 @@ def run(argv=None) -> int:
     if args.degreeBlock < 0:
         print("error: --degreeBlock must be >= 0", file=sys.stderr)
         return 2
+    # Validate mesh flags before any path that builds a mesh (the
+    # --floodCoverage branch returns early).
+    if args.meshNodes < 0 or args.meshShares < 1:
+        print(
+            "error: --meshNodes must be >= 0 and --meshShares >= 1",
+            file=sys.stderr,
+        )
+        return 2
 
     loss = None
     if not 0.0 <= args.lossProb <= 1.0:
@@ -378,9 +405,10 @@ def run(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
-        if args.backend != "tpu" or args.protocol != "push":
+        if args.backend not in ("tpu", "sharded") or args.protocol != "push":
             print(
-                "error: --floodCoverage requires --backend tpu --protocol push",
+                "error: --floodCoverage requires --backend tpu|sharded "
+                "--protocol push",
                 file=sys.stderr,
             )
             return 2
@@ -401,12 +429,6 @@ def run(argv=None) -> int:
         return 2
     if churn is not None and args.protocol != "push":
         print("error: --churnProb requires --protocol push", file=sys.stderr)
-        return 2
-    if args.meshNodes < 0 or args.meshShares < 1:
-        print(
-            "error: --meshNodes must be >= 0 and --meshShares >= 1",
-            file=sys.stderr,
-        )
         return 2
     if args.checkpoint and (args.backend != "tpu" or args.protocol != "push"):
         print(

@@ -89,3 +89,40 @@ def test_sharded_snapshots_match_event_engine():
     # 5000 > horizon: dropped by both engines.
     assert len(ev.extra["snapshots"]) == 3
     assert ev.extra["snapshots"] == sh.extra["snapshots"]
+
+
+@pytest.mark.parametrize("shards", [(4, 2), (2, 4), (8, 1)])
+def test_sharded_flood_coverage_matches_sync(shards):
+    """Per-tick coverage and counters from the mesh flood runner are
+    identical to the single-device sync engine for every mesh shape."""
+    from p2p_gossip_tpu.engine.sync import run_flood_coverage
+    from p2p_gossip_tpu.parallel.engine_sharded import (
+        run_sharded_flood_coverage,
+    )
+
+    g = pg.erdos_renyi(60, 0.1, seed=1)
+    origins = [0, 5, 30, 59]
+    st_s, cov_s = run_flood_coverage(g, origins, 40)
+    st_m, cov_m = run_sharded_flood_coverage(
+        g, origins, 40, _cpu_mesh(*shards), chunk_size=64
+    )
+    assert np.array_equal(cov_s, cov_m)
+    for f in ("generated", "received", "forwarded", "sent", "processed"):
+        assert np.array_equal(getattr(st_s, f), getattr(st_m, f))
+
+
+def test_sharded_flood_coverage_under_loss():
+    from p2p_gossip_tpu.engine.sync import run_flood_coverage
+    from p2p_gossip_tpu.models.linkloss import LinkLossModel
+    from p2p_gossip_tpu.parallel.engine_sharded import (
+        run_sharded_flood_coverage,
+    )
+
+    g = pg.erdos_renyi(50, 0.1, seed=3)
+    loss = LinkLossModel(0.4, seed=5)
+    st_s, cov_s = run_flood_coverage(g, [2, 17], 60, loss=loss)
+    st_m, cov_m = run_sharded_flood_coverage(
+        g, [2, 17], 60, _cpu_mesh(2, 2), chunk_size=64, loss=loss
+    )
+    assert np.array_equal(cov_s, cov_m)
+    assert np.array_equal(st_s.received, st_m.received)
