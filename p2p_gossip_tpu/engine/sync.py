@@ -475,17 +475,23 @@ def run_sync_sim(
     )
     snap_received = np.zeros((len(boundaries), graph.n), dtype=np.int64)
 
-    start_chunk = 0
-    ckpt_fp = None
+    log.info(
+        f"starting sync simulation: {graph.n} nodes, {graph.num_edges} links, "
+        f"{schedule.num_shares} shares in chunks of {chunk_size}, horizon "
+        f"{horizon_ticks} ticks, ring {dg.ring_size}"
+        + (f", uniform delay {dg.uniform_delay}" if dg.uniform_delay else "")
+    )
+    received = np.zeros(graph.n, dtype=np.int64)
+    sent = np.zeros(graph.n, dtype=np.int64)
+
+    checkpointer = None
     if checkpoint_path is not None:
-        if checkpoint_every < 1:
-            raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
-        from p2p_gossip_tpu.utils import checkpoint as ckpt
+        from p2p_gossip_tpu.utils.checkpoint import ChunkCheckpointer, fingerprint
 
         # Fingerprint the *effective* delays (dg may have been passed in
         # directly, overriding ell_delays/constant_delay) in canonical CSR
         # order, so the fingerprint doesn't depend on staging layout.
-        ckpt_fp = ckpt.fingerprint(
+        ckpt_fp = fingerprint(
             "sync_sim", graph.n, graph.edges(), schedule.origins,
             schedule.gen_ticks, horizon_ticks, chunk_size,
             _canonical_delays(dg), dg.uniform_delay, dg.ring_size,
@@ -498,49 +504,17 @@ def run_sync_sim(
             # snapshot-free runs keep their pre-existing fingerprints.
             *([np.asarray(boundaries, dtype=np.int64)] if boundaries else []),
         )
-        loaded = ckpt.load_checkpoint(checkpoint_path)
-        if loaded is not None:
-            arrays, meta = loaded
-            if meta.get("fingerprint") == ckpt_fp:
-                start_chunk = int(meta["next_chunk"])
-                log.info(
-                    f"resuming from {checkpoint_path} at chunk {start_chunk}"
-                )
-            else:
-                log.warn(
-                    f"checkpoint {checkpoint_path} is from a different run "
-                    "(fingerprint mismatch); starting fresh"
-                )
-
-    log.info(
-        f"starting sync simulation: {graph.n} nodes, {graph.num_edges} links, "
-        f"{schedule.num_shares} shares in chunks of {chunk_size}, horizon "
-        f"{horizon_ticks} ticks, ring {dg.ring_size}"
-        + (f", uniform delay {dg.uniform_delay}" if dg.uniform_delay else "")
-    )
-    received = np.zeros(graph.n, dtype=np.int64)
-    sent = np.zeros(graph.n, dtype=np.int64)
-    if start_chunk:
-        received += arrays["received"].astype(np.int64)
-        sent += arrays["sent"].astype(np.int64)
-        if boundaries:
-            snap_received += arrays["snap_received"].astype(np.int64)
-
-    def save(next_chunk: int) -> None:
-        ckpt.save_checkpoint(
-            checkpoint_path,
-            {
-                "received": received,
-                "sent": sent,
-                "snap_received": snap_received,
-            },
-            {"fingerprint": ckpt_fp, "next_chunk": next_chunk},
+        checkpointer = ChunkCheckpointer(
+            checkpoint_path, ckpt_fp,
+            {"received": received, "sent": sent,
+             "snap_received": snap_received},
+            checkpoint_every,
         )
 
     chunks = schedule.chunk(chunk_size)
     done_this_call = 0
     for ci, chunk in enumerate(chunks):
-        if ci < start_chunk:
+        if checkpointer is not None and ci < checkpointer.start_chunk:
             continue
         if stop_after_chunks is not None and done_this_call >= stop_after_chunks:
             break
@@ -567,10 +541,8 @@ def run_sync_sim(
             if boundaries:
                 snap_received += np.asarray(snaps, dtype=np.int64)
         done_this_call += 1
-        if checkpoint_path is not None and (
-            done_this_call % checkpoint_every == 0 or ci == len(chunks) - 1
-        ):
-            save(ci + 1)
+        if checkpointer is not None:
+            checkpointer.maybe_save(done_this_call, ci, len(chunks) - 1)
 
     generated = effective_generated(schedule, horizon_ticks, churn)
     degree = np.asarray(dg.degree, dtype=np.int64)

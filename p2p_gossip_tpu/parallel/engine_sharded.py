@@ -290,6 +290,9 @@ def run_sharded_sim(
     churn=None,
     snapshot_ticks: list[int] | None = None,
     loss=None,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 1,
+    stop_after_chunks: int | None = None,
 ) -> NodeStats:
     """Drop-in counterpart of run_sync_sim/run_event_sim on a device mesh:
     identical per-node counters, any number of shares — including under a
@@ -303,7 +306,15 @@ def run_sharded_sim(
     minor dimension at the TPU's full 128-lane tile width — narrower chunks
     demote the hot gather to a measured ~15x slower path (see
     engine.sync.MIN_CHUNK_SHARES); tests use small chunks on CPU where only
-    chunking semantics matter."""
+    chunking semantics matter.
+
+    ``checkpoint_path``/``checkpoint_every``/``stop_after_chunks`` give the
+    same pass-boundary checkpoint/resume contract as run_sync_sim: counters
+    accumulated so far are written atomically every ``checkpoint_every``
+    passes, a restart with identical inputs resumes after the last
+    completed pass, and a checkpoint from any different configuration
+    (including a different mesh shape) is detected by fingerprint and
+    ignored."""
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
     (ell_idx, ell_delay, ell_mask, degree, ring, uniform, n_padded, block,
      churn_start, churn_end) = _stage_sharded_inputs(
@@ -320,21 +331,60 @@ def run_sharded_sim(
     received = np.zeros(n_padded, dtype=np.int64)
     sent = np.zeros(n_padded, dtype=np.int64)
     snap_received = np.zeros((len(boundaries), n_padded), dtype=np.int64)
-    for chunk in schedule.chunk(pass_size):
-        live = chunk.gen_ticks < horizon_ticks
-        if not live.any():
-            continue
-        origins, gen_ticks = chunk.padded(pass_size, horizon_ticks)
-        t_start = np.int32(chunk.gen_ticks[live].min())
-        last_gen = np.int32(chunk.gen_ticks[live].max())
-        r, s, sn, _ = runner(
-            ell_idx, ell_delay, ell_mask, degree, churn_start, churn_end,
-            origins, gen_ticks, t_start, last_gen, snap_ticks_arr,
+
+    checkpointer = None
+    if checkpoint_path is not None:
+        from p2p_gossip_tpu.utils.checkpoint import (
+            ChunkCheckpointer,
+            fingerprint,
         )
-        received += np.asarray(r, dtype=np.int64)
-        sent += np.asarray(s, dtype=np.int64)
-        if boundaries:
-            snap_received += np.asarray(sn, dtype=np.int64)
+
+        # Fingerprint the caller's raw inputs (the staged layout is
+        # derived deterministically from them); mesh shape is included so
+        # a resume on a different mesh starts fresh — pass boundaries
+        # differ, so partial counters would not line up.
+        ckpt_fp = fingerprint(
+            "sharded_sim", graph.n, graph.edges(), schedule.origins,
+            schedule.gen_ticks, horizon_ticks, chunk_size,
+            mesh.shape[SHARES_AXIS], mesh.shape[NODES_AXIS],
+            ell_delays if ell_delays is not None else constant_delay,
+            churn.down_start if churn is not None else None,
+            churn.down_end if churn is not None else None,
+            np.asarray(loss.static_cfg, dtype=np.int64)
+            if loss is not None
+            else None,
+            *([np.asarray(boundaries, dtype=np.int64)] if boundaries else []),
+        )
+        checkpointer = ChunkCheckpointer(
+            checkpoint_path, ckpt_fp,
+            {"received": received, "sent": sent,
+             "snap_received": snap_received},
+            checkpoint_every,
+        )
+
+    chunks = schedule.chunk(pass_size)
+    done_this_call = 0
+    for ci, chunk in enumerate(chunks):
+        if checkpointer is not None and ci < checkpointer.start_chunk:
+            continue
+        if stop_after_chunks is not None and done_this_call >= stop_after_chunks:
+            break
+        live = chunk.gen_ticks < horizon_ticks
+        if live.any():
+            origins, gen_ticks = chunk.padded(pass_size, horizon_ticks)
+            t_start = np.int32(chunk.gen_ticks[live].min())
+            last_gen = np.int32(chunk.gen_ticks[live].max())
+            r, s, sn, _ = runner(
+                ell_idx, ell_delay, ell_mask, degree, churn_start, churn_end,
+                origins, gen_ticks, t_start, last_gen, snap_ticks_arr,
+            )
+            received += np.asarray(r, dtype=np.int64)
+            sent += np.asarray(s, dtype=np.int64)
+            if boundaries:
+                snap_received += np.asarray(sn, dtype=np.int64)
+        done_this_call += 1
+        if checkpointer is not None:
+            checkpointer.maybe_save(done_this_call, ci, len(chunks) - 1)
 
     received = received[: graph.n]
     sent = sent[: graph.n]

@@ -86,3 +86,58 @@ def load_checkpoint(path: str) -> tuple[dict[str, np.ndarray], dict] | None:
         )
         return None
     return arrays, meta
+
+
+class ChunkCheckpointer:
+    """Chunk/pass-boundary checkpoint orchestration shared by the sync and
+    sharded engines: load-and-match on construction (restoring counters in
+    place and logging the resume, or warning on a fingerprint mismatch),
+    periodic atomic saves on the engines' common cadence.
+
+    ``arrays`` maps names to the engine's live accumulator arrays; matching
+    checkpoint contents are added into them in place, and every save writes
+    their current values.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        run_fingerprint: str,
+        arrays: dict[str, np.ndarray],
+        checkpoint_every: int = 1,
+    ):
+        if checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every}"
+            )
+        self.path = path
+        self.fingerprint = run_fingerprint
+        self.arrays = arrays
+        self.checkpoint_every = checkpoint_every
+        self.start_chunk = 0
+        loaded = load_checkpoint(path)
+        if loaded is not None:
+            saved, meta = loaded
+            if meta.get("fingerprint") == run_fingerprint:
+                self.start_chunk = int(meta["next_chunk"])
+                for name, arr in arrays.items():
+                    arr += saved[name].astype(arr.dtype)
+                log.info(f"resuming from {path} at chunk {self.start_chunk}")
+            else:
+                log.warn(
+                    f"checkpoint {path} is from a different run "
+                    "(fingerprint mismatch); starting fresh"
+                )
+
+    def save(self, next_chunk: int) -> None:
+        save_checkpoint(
+            self.path,
+            self.arrays,
+            {"fingerprint": self.fingerprint, "next_chunk": next_chunk},
+        )
+
+    def maybe_save(self, done_this_call: int, ci: int, last_ci: int) -> None:
+        """The engines' shared cadence: every ``checkpoint_every`` completed
+        chunks this call, and always after the final chunk."""
+        if done_this_call % self.checkpoint_every == 0 or ci == last_ci:
+            self.save(ci + 1)

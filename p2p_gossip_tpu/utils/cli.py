@@ -147,7 +147,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--checkpoint", type=str, default="",
         help="Checkpoint file: save progress between share chunks and resume "
-        "an interrupted run from it (tpu backend only)",
+        "an interrupted run from it (tpu and sharded backends)",
     )
     p.add_argument(
         "--checkpointEvery", type=int, default=1,
@@ -430,9 +430,12 @@ def run(argv=None) -> int:
     if churn is not None and args.protocol != "push":
         print("error: --churnProb requires --protocol push", file=sys.stderr)
         return 2
-    if args.checkpoint and (args.backend != "tpu" or args.protocol != "push"):
+    if args.checkpoint and (
+        args.backend not in ("tpu", "sharded") or args.protocol != "push"
+    ):
         print(
-            "error: --checkpoint requires --backend tpu --protocol push",
+            "error: --checkpoint requires --backend tpu|sharded "
+            "--protocol push",
             file=sys.stderr,
         )
         return 2
@@ -473,6 +476,8 @@ def run(argv=None) -> int:
             g, sched, horizon, mesh, ell_delays=delays,
             chunk_size=args.chunkSize, block=args.degreeBlock or None,
             churn=churn, snapshot_ticks=snapshot_ticks, loss=loss,
+            checkpoint_path=args.checkpoint or None,
+            checkpoint_every=args.checkpointEvery,
         )
     elif args.backend == "native":
         from p2p_gossip_tpu.runtime.native import run_native_sim
