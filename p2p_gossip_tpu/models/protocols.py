@@ -30,6 +30,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from p2p_gossip_tpu.engine.sync import MIN_CHUNK_SHARES, DeviceGraph
+from p2p_gossip_tpu.models.churn import (
+    effective_generated,
+    to_device as churn_to_device,
+    up_mask_jnp,
+)
 from p2p_gossip_tpu.models.generation import Schedule
 from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
@@ -48,7 +53,8 @@ def _select_partners(key, ell_idx, ell_delay, degree):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("chunk_size", "horizon", "record_coverage")
+    jax.jit,
+    static_argnames=("chunk_size", "horizon", "record_coverage", "loss"),
 )
 def _run_pushpull(
     dg: DeviceGraph,
@@ -56,10 +62,12 @@ def _run_pushpull(
     gen_ticks: jnp.ndarray,
     key: jnp.ndarray,
     partners_override: jnp.ndarray,   # (horizon, N) int32 or (0,) when unused
+    churn=None,                       # optional ((N, K), (N, K)) intervals
     *,
     chunk_size: int,
     horizon: int,
     record_coverage: bool = False,
+    loss: tuple | None = None,
 ):
     n, w = dg.n, bitmask.num_words(chunk_size)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
@@ -96,15 +104,37 @@ def _run_pushpull(
         slot = jnp.mod(t - delay, ring)
         remote = flat[slot * n + partners]            # pull payload (N, W)
         my_old = flat[slot * n + jnp.arange(n)]       # what the partner pulls
-        pushed = scatter_or(n, partners, my_old)
+        # Failure models: an exchange with a down endpoint never happens
+        # (models/churn.py); an attempted exchange loses each direction
+        # independently to the per-link erasure coin (models/linkloss.py).
+        rows = jnp.arange(n, dtype=jnp.int32)
+        attempted = jnp.ones((n,), dtype=bool)
+        if churn is not None:
+            up = up_mask_jnp(churn[0], churn[1], t)
+            attempted = up & up[partners]
+        pull_ok = push_ok = attempted
+        if loss is not None:
+            from p2p_gossip_tpu.models.linkloss import drop_mask_jnp
+
+            thr, lseed = loss
+            pull_ok = attempted & ~drop_mask_jnp(partners, rows, t, thr, lseed)
+            push_ok = attempted & ~drop_mask_jnp(rows, partners, t, thr, lseed)
+        remote = jnp.where(pull_ok[:, None], remote, jnp.uint32(0))
+        pushed = scatter_or(
+            n, partners, jnp.where(push_ok[:, None], my_old, jnp.uint32(0))
+        )
         gen_active = gen_ticks == t
+        if churn is not None:
+            gen_active = gen_active & up[origins]
         gen_bits = bitmask.slot_scatter(n, w, origins, slots, gen_active)
         incoming = (remote | pushed) & ~seen
         newly_cnt = bitmask.popcount_rows(incoming)
-        # One digest per round to one partner (64-bit accumulation: digest
+        # One digest per attempted round to one partner; loss drops in
+        # flight, so the sender still counts (64-bit accumulation: digest
         # popcounts reach num_shares per round, horizon rounds overflow i32).
         sent_lo, sent_hi = bitmask.add_u64(
-            sent_lo, sent_hi, bitmask.popcount_rows(my_old)
+            sent_lo, sent_hi,
+            jnp.where(attempted, bitmask.popcount_rows(my_old), 0),
         )
         seen = seen | incoming | gen_bits
         received = received + newly_cnt
@@ -134,6 +164,8 @@ def run_pushpull_sim(
     partners_override: np.ndarray | None = None,
     device_graph: DeviceGraph | None = None,
     chunk_size: int = 4096,
+    churn=None,
+    loss=None,
 ):
     """Push-pull anti-entropy for ``horizon_ticks`` rounds.
 
@@ -144,6 +176,13 @@ def run_pushpull_sim(
     ``partners_override`` (horizon, N) pins each round's partner choice —
     used by the tests to compare against a numpy oracle with identical
     randomness. Returns (stats, coverage or None).
+
+    ``churn`` (models/churn.py): an exchange with a down endpoint never
+    happens (no pull, no push, no digest sent) and down nodes skip
+    generations. ``loss`` (models/linkloss.py): each direction of an
+    attempted exchange is lost independently to the per-link coin; the
+    digest sender still counts its send (in-flight loss). Both match
+    `pushpull_oracle` exactly under pinned partners.
     """
     # Partner selection indexes the full-width ELL directly, so bucketed
     # staging (which replaces it with a placeholder) is not usable here.
@@ -163,6 +202,8 @@ def run_pushpull_sim(
         else jnp.zeros((0,), dtype=jnp.int32)
     )
     key = jax.random.PRNGKey(seed)
+    churn_dev = churn_to_device(churn)
+    loss_cfg = loss.static_cfg if loss is not None else None
 
     received = np.zeros(graph.n, dtype=np.int64)
     sent = np.zeros(graph.n, dtype=np.int64)
@@ -175,9 +216,11 @@ def run_pushpull_sim(
             jnp.asarray(gen_ticks),
             key,
             override,
+            churn_dev,
             chunk_size=chunk_size,
             horizon=horizon_ticks,
             record_coverage=record_coverage,
+            loss=loss_cfg,
         )
         received += np.asarray(r, dtype=np.int64)
         sent += bitmask.combine_u64(s_lo, s_hi)
@@ -186,7 +229,7 @@ def run_pushpull_sim(
 
     # Digest traffic is per-round per-node regardless of chunking: chunking
     # splits the digest into per-chunk digests, so `sent` stays exact.
-    generated = schedule.generated_per_node(horizon_ticks).astype(np.int64)
+    generated = effective_generated(schedule, horizon_ticks, churn)
     stats = NodeStats(
         generated=generated,
         received=received,
@@ -204,29 +247,51 @@ def pushpull_oracle(
     schedule: Schedule,
     horizon_ticks: int,
     partners: np.ndarray,
+    churn=None,
+    loss=None,
 ) -> NodeStats:
     """Plain-numpy specification of one-tick-delay push-pull with pinned
-    partner choices — the oracle the TPU engine is tested against."""
+    partner choices — the oracle the TPU engine is tested against,
+    including under churn and link-loss models (same gating rules as
+    `_run_pushpull`)."""
+    from p2p_gossip_tpu.models.linkloss import drop_mask_np
+
     n = graph.n
     s = schedule.num_shares
     seen = np.zeros((n, s), dtype=bool)
     hist = [np.zeros((n, s), dtype=bool) for _ in range(2)]
     received = np.zeros(n, dtype=np.int64)
     sent = np.zeros(n, dtype=np.int64)
+    rows = np.arange(n)
     for t in range(horizon_ticks):
         old = hist[(t - 1) % 2]
         p = partners[t]
-        incoming = old[p]  # pull
+        attempted = np.ones(n, dtype=bool)
+        if churn is not None:
+            up = churn.up_mask(t)
+            attempted = up & up[p]
+        pull_ok = push_ok = attempted
+        if loss is not None:
+            pull_ok = attempted & ~drop_mask_np(
+                p, rows, t, loss.threshold, loss.seed
+            )
+            push_ok = attempted & ~drop_mask_np(
+                rows, p, t, loss.threshold, loss.seed
+            )
+        incoming = old[p] & pull_ok[:, None]  # pull
         for i in range(n):  # push
-            incoming[p[i]] = incoming[p[i]] | old[i]
-        sent += old.sum(axis=1)
+            if push_ok[i]:
+                incoming[p[i]] = incoming[p[i]] | old[i]
+        sent += np.where(attempted, old.sum(axis=1), 0)
         newly = incoming & ~seen
         received += newly.sum(axis=1)
         seen |= newly
         gen_now = schedule.gen_ticks == t
+        if churn is not None:
+            gen_now = gen_now & up[schedule.origins]
         seen[schedule.origins[gen_now], np.flatnonzero(gen_now)] = True
         hist[t % 2] = seen.copy()
-    generated = schedule.generated_per_node(horizon_ticks).astype(np.int64)
+    generated = effective_generated(schedule, horizon_ticks, churn)
     return NodeStats(
         generated=generated,
         received=received,

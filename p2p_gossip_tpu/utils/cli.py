@@ -424,12 +424,8 @@ def run(argv=None) -> int:
     if args.protocol == "pushpull" and args.backend != "tpu":
         print("error: --protocol pushpull requires --backend tpu", file=sys.stderr)
         return 2
-    if loss is not None and args.protocol != "push":
-        print("error: --lossProb requires --protocol push", file=sys.stderr)
-        return 2
-    if churn is not None and args.protocol != "push":
-        print("error: --churnProb requires --protocol push", file=sys.stderr)
-        return 2
+
+
     if args.checkpoint and (
         args.backend not in ("tpu", "sharded") or args.protocol != "push"
     ):
@@ -449,7 +445,7 @@ def run(argv=None) -> int:
 
         stats, _ = run_pushpull_sim(
             g, sched, horizon, ell_delays=delays, seed=args.seed,
-            chunk_size=args.chunkSize,
+            chunk_size=args.chunkSize, churn=churn, loss=loss,
         )
     elif args.backend == "tpu":
         from p2p_gossip_tpu.engine.sync import run_sync_sim

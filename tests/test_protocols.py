@@ -113,3 +113,52 @@ def test_pushpull_sent_counts_digests():
     assert stats.sent.sum() > g.n
     assert stats.sent.sum() <= 30 * g.n
     assert (stats.received == stats.forwarded).all()
+
+
+def test_pushpull_churn_loss_matches_oracle():
+    """Push-pull under churn and link loss: engine == numpy oracle with
+    pinned partners; each model reduces spread."""
+    from p2p_gossip_tpu.models.churn import ChurnModel
+    from p2p_gossip_tpu.models.linkloss import LinkLossModel
+    from p2p_gossip_tpu.models.protocols import pushpull_oracle
+
+    g = pg.erdos_renyi(40, 0.15, seed=3)
+    rng = np.random.default_rng(3)
+    horizon = 25
+    # Pinned uniform-random neighbor choices (valid for every node).
+    deg = g.degree
+    partners = np.stack([
+        g.indices[g.indptr[:-1] + rng.integers(0, deg)]
+        for _ in range(horizon)
+    ]).astype(np.int32)
+    sched = single_share_schedule(g.n, origin=0)
+    down_start = np.full((g.n, 1), 10**9, dtype=np.int32)
+    down_end = np.zeros((g.n, 1), dtype=np.int32)
+    down_start[5, 0], down_end[5, 0] = 0, horizon   # node 5 down all run
+    down_start[11, 0], down_end[11, 0] = 5, 15
+    churn = ChurnModel(n=g.n, down_start=down_start, down_end=down_end)
+    loss = LinkLossModel(0.3, seed=9)
+
+    base, base_cov = run_pushpull_sim(
+        g, sched, horizon, partners_override=partners, record_coverage=True
+    )
+    for kw in (
+        dict(churn=churn),
+        dict(loss=loss),
+        dict(churn=churn, loss=loss),
+    ):
+        got, cov = run_pushpull_sim(
+            g, sched, horizon, partners_override=partners,
+            record_coverage=True, **kw
+        )
+        want = pushpull_oracle(g, sched, horizon, partners, **kw)
+        assert got.equal_counts(want), kw
+        # The failure model slows spread: cumulative coverage strictly
+        # below the failure-free run (anti-entropy may still fully
+        # converge by the horizon — loss delays, churn removes).
+        assert cov.sum() < base_cov.sum(), kw
+    # The always-down node learns nothing and sends nothing.
+    got, _ = run_pushpull_sim(
+        g, sched, horizon, partners_override=partners, churn=churn
+    )
+    assert got.received[5] == 0 and got.sent[5] == 0
