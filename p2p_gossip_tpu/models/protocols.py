@@ -2,9 +2,10 @@
 
 The reference implements one protocol: eager push flooding (every new share
 is immediately re-broadcast to all peers, p2pnode.cc:155-165) — that is
-`engine.sync` / `engine.event`. This module adds the classic low-bandwidth
-alternative from BASELINE.json config 5: **push-pull anti-entropy** with
-optional per-edge latency delay lines.
+`engine.sync` / `engine.event`. This module adds the two classic
+low-bandwidth alternatives: **push-pull anti-entropy** (BASELINE.json
+config 5) and **fanout-limited push** (rumor mongering), both with optional
+per-edge latency delay lines.
 
 Each round, every node picks one uniform-random neighbor and exchanges
 digests both ways:
@@ -183,7 +184,37 @@ def run_pushpull_sim(
     attempted exchange is lost independently to the per-link coin; the
     digest sender still counts its send (in-flight loss). Both match
     `pushpull_oracle` exactly under pinned partners.
+
+    Digest traffic is per-round per-node regardless of chunking: chunking
+    splits the digest into per-chunk digests, so `sent` stays exact.
     """
+    return _run_partnered_sim(
+        _run_pushpull, graph, schedule, horizon_ticks, ell_delays,
+        constant_delay, seed, record_coverage, partners_override,
+        device_graph, chunk_size, churn, loss,
+    )
+
+
+def _run_partnered_sim(
+    kernel,
+    graph: Graph,
+    schedule: Schedule,
+    horizon_ticks: int,
+    ell_delays,
+    constant_delay,
+    seed,
+    record_coverage,
+    partners_override,
+    device_graph,
+    chunk_size,
+    churn,
+    loss,
+):
+    """Shared chunk driver for the random-partner protocols (push-pull,
+    fanout push). ``kernel`` is a jitted round loop with `_run_pushpull`'s
+    signature returning (seen, received, sent-u64-pair, coverage); partner
+    selection inside it must be keyed only by (seed, round) so counters
+    stay exactly additive across share chunks."""
     # Partner selection indexes the full-width ELL directly, so bucketed
     # staging (which replaces it with a placeholder) is not usable here.
     dg = device_graph or DeviceGraph.build(
@@ -191,8 +222,8 @@ def run_pushpull_sim(
     )
     if dg.buckets is not None:
         raise ValueError(
-            "push-pull requires a DeviceGraph built with bucketed=False "
-            "(random partner selection reads the full ELL)"
+            "random-partner protocols require a DeviceGraph built with "
+            "bucketed=False (partner selection reads the full ELL)"
         )
     chunk_size = min(chunk_size, max(MIN_CHUNK_SHARES, schedule.num_shares))
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
@@ -210,7 +241,7 @@ def run_pushpull_sim(
     cov_chunks = []
     for chunk in schedule.chunk(chunk_size) or [schedule]:
         origins, gen_ticks = chunk.padded(chunk_size, horizon_ticks)
-        _, r, (s_lo, s_hi), coverage = _run_pushpull(
+        _, r, (s_lo, s_hi), coverage = kernel(
             dg,
             jnp.asarray(origins),
             jnp.asarray(gen_ticks),
@@ -227,8 +258,6 @@ def run_pushpull_sim(
         if record_coverage:
             cov_chunks.append(np.asarray(coverage)[:, : chunk.num_shares])
 
-    # Digest traffic is per-round per-node regardless of chunking: chunking
-    # splits the digest into per-chunk digests, so `sent` stays exact.
     generated = effective_generated(schedule, horizon_ticks, churn)
     stats = NodeStats(
         generated=generated,
@@ -291,6 +320,228 @@ def pushpull_oracle(
             gen_now = gen_now & up[schedule.origins]
         seen[schedule.origins[gen_now], np.flatnonzero(gen_now)] = True
         hist[t % 2] = seen.copy()
+    generated = effective_generated(schedule, horizon_ticks, churn)
+    return NodeStats(
+        generated=generated,
+        received=received,
+        forwarded=received.copy(),
+        sent=sent,
+        processed=generated + received,
+        degree=graph.degree.astype(np.int64),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fanout-limited push ("rumor mongering")
+# ---------------------------------------------------------------------------
+
+def _select_fanout_partners(key, ell_idx, ell_delay, degree, fanout):
+    """``fanout`` independent uniform neighbor picks per node (with
+    replacement — duplicate picks are independent sends), plus each picked
+    edge's delay. Returns ((N, k) partners, (N, k) delays)."""
+    n, _ = ell_idx.shape
+    kidx = jax.random.randint(
+        key, (n, fanout), minval=0, maxval=jnp.maximum(degree, 1)[:, None]
+    )
+    rows = jnp.arange(n)[:, None]
+    return ell_idx[rows, kidx], ell_delay[rows, kidx]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("fanout", "chunk_size", "horizon", "record_coverage", "loss"),
+)
+def _run_pushk(
+    dg: DeviceGraph,
+    origins: jnp.ndarray,
+    gen_ticks: jnp.ndarray,
+    key: jnp.ndarray,
+    partners_override: jnp.ndarray,   # (horizon, N, k) int32 or (0,) unused
+    churn=None,                       # optional ((N, K), (N, K)) intervals
+    *,
+    fanout: int,
+    chunk_size: int,
+    horizon: int,
+    record_coverage: bool = False,
+    loss: tuple | None = None,
+):
+    n, w = dg.n, bitmask.num_words(chunk_size)
+    slots = jnp.arange(chunk_size, dtype=jnp.int32)
+    ring = dg.ring_size
+    use_override = partners_override.ndim == 3
+    rows = jnp.arange(n, dtype=jnp.int32)
+
+    state = (
+        jnp.zeros((n, w), dtype=jnp.uint32),          # seen
+        jnp.zeros((ring, n, w), dtype=jnp.uint32),    # frontier history ring
+        jnp.zeros((n,), dtype=jnp.int32),             # received
+        jnp.zeros((n,), dtype=jnp.uint32),            # sent lo (64-bit pair)
+        jnp.zeros((n,), dtype=jnp.uint32),            # sent hi
+    )
+
+    def step(state, t):
+        seen, hist, received, sent_lo, sent_hi = state
+        if use_override:
+            partners = partners_override[t]
+            delay = jnp.ones((n, fanout), dtype=jnp.int32)
+        elif dg.uniform_delay is not None:
+            partners, _ = _select_fanout_partners(
+                jax.random.fold_in(key, t), dg.ell_idx,
+                jnp.zeros_like(dg.ell_idx), dg.degree, fanout,
+            )
+            delay = jnp.full((n, fanout), dg.uniform_delay, dtype=jnp.int32)
+        else:
+            partners, delay = _select_fanout_partners(
+                jax.random.fold_in(key, t), dg.ell_idx, dg.ell_delay,
+                dg.degree, fanout,
+            )
+        # Each pick pushes the sender's FRONTIER (newly|gen) as of `delay`
+        # ticks ago — the same delay-line convention as push-pull above.
+        flat = hist.reshape(ring * n, w)
+        slot = jnp.mod(t - delay, ring)               # (N, k)
+        payload = flat[slot * n + rows[:, None]]      # (N, k, W)
+        attempted = jnp.ones((n, fanout), dtype=bool)
+        if churn is not None:
+            up = up_mask_jnp(churn[0], churn[1], t)
+            attempted = up[:, None] & up[partners]
+        push_ok = attempted
+        if loss is not None:
+            from p2p_gossip_tpu.models.linkloss import drop_mask_jnp
+
+            thr, lseed = loss
+            push_ok = attempted & ~drop_mask_jnp(
+                rows[:, None], partners, t, thr, lseed
+            )
+        payload_ok = jnp.where(push_ok[..., None], payload, jnp.uint32(0))
+        incoming = scatter_or(
+            n, partners.reshape(-1), payload_ok.reshape(n * fanout, w)
+        )
+        # The sender counts every attempted pick (loss drops in flight);
+        # per-pick cost is the pushed frontier's popcount.
+        pick_cnt = bitmask.popcount_rows(
+            payload.reshape(n * fanout, w)
+        ).reshape(n, fanout)
+        sent_lo, sent_hi = bitmask.add_u64(
+            sent_lo, sent_hi,
+            jnp.sum(jnp.where(attempted, pick_cnt, 0), axis=1),
+        )
+        gen_active = gen_ticks == t
+        if churn is not None:
+            gen_active = gen_active & up[origins]
+        gen_bits = bitmask.slot_scatter(n, w, origins, slots, gen_active)
+        newly = incoming & ~seen
+        received = received + bitmask.popcount_rows(newly)
+        seen = seen | newly | gen_bits
+        hist = hist.at[jnp.mod(t, ring)].set(newly | gen_bits)
+        cov = (
+            bitmask.coverage_per_slot(seen, chunk_size)
+            if record_coverage
+            else jnp.zeros((0,), jnp.int32)
+        )
+        return (seen, hist, received, sent_lo, sent_hi), cov
+
+    state, coverage = jax.lax.scan(
+        step, state, jnp.arange(horizon, dtype=jnp.int32)
+    )
+    seen, _, received, sent_lo, sent_hi = state
+    return seen, received, (sent_lo, sent_hi), coverage
+
+
+def run_pushk_sim(
+    graph: Graph,
+    schedule: Schedule,
+    horizon_ticks: int,
+    fanout: int = 2,
+    ell_delays: np.ndarray | None = None,
+    constant_delay: int = 1,
+    seed: int = 0,
+    record_coverage: bool = False,
+    partners_override: np.ndarray | None = None,
+    device_graph: DeviceGraph | None = None,
+    chunk_size: int = 4096,
+    churn=None,
+    loss=None,
+):
+    """Fanout-limited push gossip ("rumor mongering") for ``horizon_ticks``
+    rounds.
+
+    Where the reference floods every new share to ALL peers
+    (p2pnode.cc:127), each node here pushes its frontier — the shares it
+    newly acquired — to ``fanout`` uniform-random neighbor picks per round
+    (with replacement; duplicate picks are independent sends sharing one
+    loss coin). With a uniform delay every share a node acquires is pushed
+    exactly once per pick at tick ``acquired + delay``, so the reference's
+    send law becomes ``sent == (generated + forwarded) * fanout``; coverage
+    is probabilistic, not guaranteed — the classic bandwidth/coverage
+    trade-off this variant exists to explore.
+
+    Counter mapping: ``received``/``forwarded`` count newly acquired shares
+    exactly as in the reference; ``sent`` counts share-transmissions over
+    attempted picks. Partner picks are keyed only by (seed, round), so
+    share-chunking leaves counters exactly additive. ``partners_override``
+    (horizon, N, fanout) pins the picks for the oracle-parity tests (and
+    forces the oracle's one-tick delay). ``churn``/``loss`` follow
+    `run_pushpull_sim`: a pick with a down endpoint never happens; loss
+    drops each attempted pick in flight (sender still counts).
+    Returns (stats, coverage or None).
+    """
+    if fanout < 1:
+        raise ValueError(f"fanout must be >= 1, got {fanout}")
+    return _run_partnered_sim(
+        functools.partial(_run_pushk, fanout=fanout), graph, schedule,
+        horizon_ticks, ell_delays, constant_delay, seed, record_coverage,
+        partners_override, device_graph, chunk_size, churn, loss,
+    )
+
+
+def pushk_oracle(
+    graph: Graph,
+    schedule: Schedule,
+    horizon_ticks: int,
+    partners: np.ndarray,   # (horizon, N, k) pinned picks
+    churn=None,
+    loss=None,
+) -> NodeStats:
+    """Plain-numpy specification of one-tick-delay fanout push with pinned
+    partner picks — the oracle `run_pushk_sim` is tested against, including
+    under churn and link-loss (same gating rules as `_run_pushk`)."""
+    from p2p_gossip_tpu.models.linkloss import drop_mask_np
+
+    n = graph.n
+    s = schedule.num_shares
+    k = partners.shape[2]
+    seen = np.zeros((n, s), dtype=bool)
+    hist = [np.zeros((n, s), dtype=bool) for _ in range(2)]
+    received = np.zeros(n, dtype=np.int64)
+    sent = np.zeros(n, dtype=np.int64)
+    rows = np.arange(n)
+    for t in range(horizon_ticks):
+        front_old = hist[(t - 1) % 2]
+        p = partners[t]
+        attempted = np.ones((n, k), dtype=bool)
+        if churn is not None:
+            up = churn.up_mask(t)
+            attempted = up[:, None] & up[p]
+        push_ok = attempted
+        if loss is not None:
+            push_ok = attempted & ~drop_mask_np(
+                rows[:, None], p, t, loss.threshold, loss.seed
+            )
+        incoming = np.zeros((n, s), dtype=bool)
+        for i in range(n):
+            for j in range(k):
+                if push_ok[i, j]:
+                    incoming[p[i, j]] |= front_old[i]
+        sent += front_old.sum(axis=1) * attempted.sum(axis=1)
+        newly = incoming & ~seen
+        received += newly.sum(axis=1)
+        front = newly.copy()
+        gen_now = schedule.gen_ticks == t
+        if churn is not None:
+            gen_now = gen_now & up[schedule.origins]
+        front[schedule.origins[gen_now], np.flatnonzero(gen_now)] = True
+        seen |= front
+        hist[t % 2] = front
     generated = effective_generated(schedule, horizon_ticks, churn)
     return NodeStats(
         generated=generated,

@@ -1,0 +1,126 @@
+"""Propagation analysis — latency percentiles and traffic redundancy.
+
+The reference's report stops at raw counters (PrintStatistics,
+p2pnetwork.cc:253-285). These metrics answer the questions a gossip
+simulation is usually run to answer:
+
+- **propagation latency**: ticks from a share's generation until it has
+  reached a fraction of the network, per share, summarized across shares —
+  computed from the per-tick coverage history the TPU engines record
+  (engine.sync.run_flood_coverage, models.protocols with
+  ``record_coverage=True``);
+- **redundancy**: share-transmissions per unique delivery. Flooding costs
+  ~mean-degree sends per delivery (every processed share goes to every
+  peer, p2pnode.cc:127); fanout-k push costs ~k — the bandwidth/coverage
+  trade-off the protocol family exists to explore.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+
+import numpy as np
+
+from p2p_gossip_tpu.utils.stats import NodeStats
+
+
+@dataclasses.dataclass(frozen=True)
+class PropagationReport:
+    """Per-share propagation latency at several coverage fractions.
+
+    ``latency[f]`` is an (S,) int64 array: ticks from each share's
+    generation tick until coverage first reached ``ceil(f * n)`` nodes
+    (-1 where the share never got there within the horizon).
+    """
+
+    n: int
+    fractions: tuple[float, ...]
+    latency: dict[float, np.ndarray]
+
+    def summary(self, fraction: float) -> dict[str, float]:
+        """median / p95 / max / reached-share over shares that reached the
+        fraction (NaN-free: all -1 when none did)."""
+        lat = self.latency[fraction]
+        ok = lat >= 0
+        if not ok.any():
+            return {"median": -1.0, "p95": -1.0, "max": -1.0, "reached": 0.0}
+        hit = lat[ok].astype(np.float64)
+        return {
+            "median": float(np.median(hit)),
+            "p95": float(np.percentile(hit, 95)),
+            "max": float(hit.max()),
+            "reached": float(ok.mean()),
+        }
+
+
+def propagation_latency(
+    coverage: np.ndarray,
+    n: int,
+    gen_ticks: np.ndarray | None = None,
+    fractions: tuple[float, ...] = (0.5, 0.9, 0.99, 1.0),
+) -> PropagationReport:
+    """Latency-to-coverage per share from a (T, S) coverage history.
+
+    ``coverage[t, s]`` counts nodes that have seen share ``s`` by the end
+    of tick ``t`` (monotone in t). ``gen_ticks`` (S,) subtracts each
+    share's generation tick (default 0 — the flood-coverage experiment's
+    all-at-t=0 convention).
+    """
+    coverage = np.asarray(coverage)
+    horizon, s = coverage.shape
+    gen = (
+        np.zeros(s, dtype=np.int64)
+        if gen_ticks is None
+        else np.asarray(gen_ticks, dtype=np.int64)
+    )
+    latency: dict[float, np.ndarray] = {}
+    for f in fractions:
+        if not 0.0 < f <= 1.0:
+            raise ValueError(f"fractions must be in (0, 1], got {f}")
+        target = int(np.ceil(f * n))
+        hit = coverage >= target
+        first = np.where(hit.any(axis=0), hit.argmax(axis=0), -1)
+        lat = first.astype(np.int64) - gen
+        latency[f] = np.where(first >= 0, np.maximum(lat, 0), -1)
+    return PropagationReport(n=n, fractions=tuple(fractions), latency=latency)
+
+
+def message_redundancy(stats: NodeStats) -> dict[str, float]:
+    """Traffic cost of the run: transmissions per unique delivery.
+
+    ``sends_per_delivery`` is total share-transmissions (`sent`) over total
+    first-time deliveries (`received`); ``wasted_fraction`` is the share of
+    transmissions that were duplicates at the receiver (dropped by dedup,
+    p2pnode.cc:189) or lost. For pure flooding on a static graph this
+    approaches the mean degree — each delivery is paid for ~degree times.
+    """
+    t = stats.totals()
+    delivered = t["received"]
+    sent = t["sent"]
+    return {
+        "sent": float(sent),
+        "delivered": float(delivered),
+        "sends_per_delivery": sent / delivered if delivered else float("inf"),
+        "wasted_fraction": 1.0 - delivered / sent if sent else 0.0,
+    }
+
+
+def format_propagation_report(
+    report: PropagationReport, tick_ms: float | None = None
+) -> str:
+    """Human-readable latency table (ticks, plus ms when ``tick_ms`` is
+    the CLI's per-tick latency)."""
+    out = io.StringIO()
+    out.write("=== Propagation Latency ===\n")
+    for f in report.fractions:
+        s = report.summary(f)
+        line = (
+            f"{int(round(f * 100)):3d}% coverage: "
+            f"median {s['median']:g}, p95 {s['p95']:g}, max {s['max']:g} ticks"
+        )
+        if tick_ms is not None and s["median"] >= 0:
+            line += f" (median {s['median'] * tick_ms:g} ms)"
+        line += f"; {s['reached'] * 100:.1f}% of shares reached\n"
+        out.write(line)
+    return out.getvalue()

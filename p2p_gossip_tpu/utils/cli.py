@@ -87,9 +87,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="Columns for --topology grid/torus (default: ~sqrt(numNodes))",
     )
     p.add_argument(
-        "--protocol", choices=("push", "pushpull"), default="push",
-        help="Gossip protocol: push flooding (reference) or push-pull "
-        "anti-entropy (tpu backend only)",
+        "--protocol", choices=("push", "pushpull", "pushk"), default="push",
+        help="Gossip protocol: push flooding (reference), push-pull "
+        "anti-entropy, or fanout-limited push (both tpu backend only)",
+    )
+    p.add_argument(
+        "--fanout", type=int, default=2,
+        help="Random neighbor picks per round for --protocol pushk",
     )
     p.add_argument(
         "--genModel", choices=("uniform", "poisson"), default="uniform",
@@ -228,6 +232,19 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
         f"Final coverage: min {coverage[-1].min()} / "
         f"mean {coverage[-1].mean():.1f} / max {coverage[-1].max()} nodes"
     )
+    from p2p_gossip_tpu.utils.analysis import (
+        format_propagation_report,
+        message_redundancy,
+        propagation_latency,
+    )
+
+    report = propagation_latency(coverage, g.n)
+    print(format_propagation_report(report, tick_ms=args.Latency), end="")
+    red = message_redundancy(stats)
+    print(
+        f"Redundancy: {red['sends_per_delivery']:.2f} sends per delivery "
+        f"({red['wasted_fraction']:.1%} duplicate or lost)"
+    )
     print(
         f"Simulated {horizon} ticks in {wall:.3f}s wall "
         f"({stats.totals()['processed'] / max(wall, 1e-9):.3g} node-updates/s)"
@@ -238,6 +255,11 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
 def run(argv=None) -> int:
     args = build_parser().parse_args(argv)
     tick_dt = args.Latency / 1000.0
+    from p2p_gossip_tpu.utils.platform import force_cpu_backend_if_requested
+
+    # JAX_PLATFORMS=cpu must mean CPU even on a box whose TPU tunnel
+    # plugin would otherwise be dialed (and, when down, hang the run).
+    force_cpu_backend_if_requested()
     from p2p_gossip_tpu.utils import logging as p2plog
 
     if args.log:
@@ -421,8 +443,14 @@ def run(argv=None) -> int:
             return 2
         return _run_flood_coverage_cli(args, g, horizon, delays, churn, loss)
 
-    if args.protocol == "pushpull" and args.backend != "tpu":
-        print("error: --protocol pushpull requires --backend tpu", file=sys.stderr)
+    if args.protocol in ("pushpull", "pushk") and args.backend != "tpu":
+        print(
+            f"error: --protocol {args.protocol} requires --backend tpu",
+            file=sys.stderr,
+        )
+        return 2
+    if args.protocol == "pushk" and args.fanout < 1:
+        print("error: --fanout must be >= 1", file=sys.stderr)
         return 2
 
 
@@ -446,6 +474,13 @@ def run(argv=None) -> int:
         stats, _ = run_pushpull_sim(
             g, sched, horizon, ell_delays=delays, seed=args.seed,
             chunk_size=args.chunkSize, churn=churn, loss=loss,
+        )
+    elif args.protocol == "pushk":
+        from p2p_gossip_tpu.models.protocols import run_pushk_sim
+
+        stats, _ = run_pushk_sim(
+            g, sched, horizon, fanout=args.fanout, ell_delays=delays,
+            seed=args.seed, chunk_size=args.chunkSize, churn=churn, loss=loss,
         )
     elif args.backend == "tpu":
         from p2p_gossip_tpu.engine.sync import run_sync_sim

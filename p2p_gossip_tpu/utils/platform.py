@@ -1,0 +1,39 @@
+"""Backend-platform hygiene for boxes with an injected TPU tunnel plugin.
+
+This box registers an experimental TPU PJRT plugin ("axon") from a
+sitecustomize hook, so it is already registered before any of our code
+runs. jax's first device query initializes EVERY registered backend — it
+dials the TPU tunnel even under ``JAX_PLATFORMS=cpu`` — and a slow or
+down tunnel stalls what should be a CPU-only run. Used by the CLI, the
+driver entry points, and tests/conftest.py.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def cpu_requested() -> bool:
+    """True when the user explicitly pinned jax to CPU via env."""
+    return os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu"
+
+
+def force_cpu_backend_if_requested() -> None:
+    """Deregister the TPU tunnel plugin when ``JAX_PLATFORMS=cpu``.
+
+    Best-effort via private jax internals: on a jax version that moves
+    them, degrades to the prior behavior (CPU runs need a live tunnel)
+    rather than raising.
+    """
+    if not cpu_requested():
+        return
+    import jax
+
+    try:
+        import jax._src.xla_bridge as xb
+
+        getattr(xb, "_backend_factories", {}).pop("axon", None)
+    except Exception:
+        pass
+    # The plugin also pins jax_platforms via config, outranking the env var.
+    jax.config.update("jax_platforms", "cpu")

@@ -24,6 +24,13 @@ import time
 
 import numpy as np
 
+# Self-locate the repo so the script runs from any cwd. Deliberately an
+# in-process sys.path edit and NOT a PYTHONPATH requirement: PYTHONPATH
+# propagates into the TPU tunnel plugin's helper subprocess and breaks its
+# backend registration ("Backend 'axon' is not in the list of known
+# backends" whenever PYTHONPATH points here).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -34,6 +41,19 @@ def main() -> None:
     ap.add_argument("--nodes", type=int, default=1_000_000)
     ap.add_argument("--prob", type=float, default=0.001)
     ap.add_argument("--shares", type=int, default=4096)
+    ap.add_argument(
+        "--chunk", type=int, default=0,
+        help="Shares per device pass (0 = all at once). Chunks below 4096 "
+        "shares drop the row gather under the TPU's 128-lane tile width — "
+        "prefer --block for memory relief.",
+    )
+    ap.add_argument(
+        "--block", type=int, default=8,
+        help="Degree-block for the gather-OR scan. The per-step gather "
+        "intermediate is rows x block x words x 4 B — at N=1M / 4096 "
+        "shares the 100K-swept block of 64 wants ~26 GB of HBM, so the "
+        "default here stays at 8 (~4 GB).",
+    )
     ap.add_argument("--horizon", type=int, default=64)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument(
@@ -51,6 +71,12 @@ def main() -> None:
     )
     from p2p_gossip_tpu.runtime import native
 
+    # Initialize the TPU backend BEFORE the multi-GB graph load: the axon
+    # tunnel plugin fails to register under the memory pressure / delay of
+    # loading first (observed: "Backend 'axon' is not in the list of known
+    # backends" iff devices() first fires after the 4 GB npz load).
+    devices = jax.devices()
+
     t0 = time.perf_counter()
     if args.cache and os.path.exists(args.cache):
         d = np.load(args.cache)
@@ -66,7 +92,7 @@ def main() -> None:
                      indices=graph.indices)
     log(
         f"N={graph.n} edges={graph.num_edges} dmax={graph.max_degree} "
-        f"devices={jax.devices()}"
+        f"devices={devices}"
     )
 
     t0 = time.perf_counter()
@@ -75,22 +101,31 @@ def main() -> None:
 
     rng = np.random.default_rng(args.seed)
     origins = rng.integers(0, graph.n, args.shares).astype(np.int32)
+    chunk = max(32, min(args.chunk, args.shares)) if args.chunk else args.shares
+
+    def flood_all():
+        """Shares are independent: chunked passes, counters additive."""
+        processed = 0
+        covs = []
+        for lo in range(0, args.shares, chunk):
+            stats, cov = run_flood_coverage(
+                graph, origins[lo : lo + chunk], args.horizon,
+                device_graph=dg, block=args.block,
+            )
+            processed += stats.totals()["processed"]
+            covs.append(cov)
+        return processed, np.concatenate(covs, axis=1)
 
     t0 = time.perf_counter()
-    stats, cov = run_flood_coverage(
-        graph, origins, args.horizon, device_graph=dg
-    )
+    flood_all()
     warm_wall = time.perf_counter() - t0
     log(f"warmup (incl. compile): {warm_wall:.1f}s")
 
     t0 = time.perf_counter()
-    stats, cov = run_flood_coverage(
-        graph, origins, args.horizon, device_graph=dg
-    )
+    processed, cov = flood_all()
     wall = time.perf_counter() - t0
 
     ttc = time_to_coverage(cov, graph.n, 0.99)
-    processed = stats.totals()["processed"]
     full = processed == args.shares * graph.n
     log(
         f"flood: {processed} node-updates in {wall:.1f}s, full coverage: "

@@ -1,0 +1,81 @@
+"""Propagation-latency and redundancy analysis tests."""
+
+import numpy as np
+
+import p2p_gossip_tpu as pg
+from p2p_gossip_tpu.engine.sync import run_flood_coverage
+from p2p_gossip_tpu.models.generation import single_share_schedule
+from p2p_gossip_tpu.utils.analysis import (
+    format_propagation_report,
+    message_redundancy,
+    propagation_latency,
+)
+
+
+def test_propagation_latency_hand_built_history():
+    # Share 0: gen at t=0, covers 5/10 at t=2, all 10 at t=4.
+    # Share 1: gen at t=1, never passes 6 nodes.
+    cov = np.array(
+        [
+            [1, 0],
+            [3, 1],
+            [5, 2],
+            [8, 4],
+            [10, 6],
+            [10, 6],
+        ]
+    )
+    rep = propagation_latency(
+        cov, n=10, gen_ticks=np.array([0, 1]), fractions=(0.5, 1.0)
+    )
+    np.testing.assert_array_equal(rep.latency[0.5], [2, 3])  # t=4 minus gen 1
+    np.testing.assert_array_equal(rep.latency[1.0], [4, -1])
+    s = rep.summary(1.0)
+    assert s["reached"] == 0.5 and s["max"] == 4.0
+    text = format_propagation_report(rep, tick_ms=5.0)
+    assert "50% coverage" in text and "20 ms" in text
+
+
+def test_propagation_latency_from_flood_run():
+    g = pg.erdos_renyi(200, 0.06, seed=1)
+    origins = np.array([0, 50, 199], dtype=np.int32)
+    stats, cov = run_flood_coverage(g, origins, 32)
+    rep = propagation_latency(cov, g.n)
+    # Flooding a connected graph covers everyone; latency bounded by diameter.
+    lat = rep.latency[1.0]
+    assert (lat >= 1).all()
+    assert (lat <= 32).all()
+    # Higher fractions can only take longer.
+    assert (rep.latency[0.5] <= rep.latency[0.99]).all()
+    assert (rep.latency[0.99] <= rep.latency[1.0]).all()
+
+
+def test_message_redundancy_flood_approaches_mean_degree():
+    g = pg.erdos_renyi(150, 0.08, seed=2)
+    sched = single_share_schedule(g.n, origin=0)
+    stats = __import__(
+        "p2p_gossip_tpu.engine.sync", fromlist=["run_sync_sim"]
+    ).run_sync_sim(g, sched, 64)
+    red = message_redundancy(stats)
+    mean_deg = g.degree.mean()
+    # sent == processed * degree, delivered == n - 1.
+    assert 0.8 * mean_deg < red["sends_per_delivery"] < 1.3 * mean_deg
+    assert 0.0 < red["wasted_fraction"] < 1.0
+
+
+def test_redundancy_pushk_beats_flood():
+    from p2p_gossip_tpu.engine.sync import run_sync_sim
+    from p2p_gossip_tpu.models.protocols import run_pushk_sim
+
+    g = pg.erdos_renyi(128, 0.1, seed=5)
+    sched = single_share_schedule(g.n, origin=0)
+    flood = message_redundancy(run_sync_sim(g, sched, 64))
+    pushk = message_redundancy(run_pushk_sim(g, sched, 64, fanout=4, seed=5)[0])
+    assert pushk["sends_per_delivery"] < flood["sends_per_delivery"] / 2
+
+
+def test_propagation_latency_rejects_bad_fraction():
+    import pytest
+
+    with pytest.raises(ValueError):
+        propagation_latency(np.zeros((4, 1)), n=10, fractions=(0.0,))
