@@ -1,0 +1,70 @@
+"""Counter-based random partner selection — deterministic across engines.
+
+The random-partner protocols (push-pull anti-entropy, fanout-limited push;
+models/protocols.py) need "node n picks a uniform-random neighbor at round
+t". Sampling that from PRNG *state* would make the choice depend on array
+shapes and shard layout; instead the pick is a pure counter-based hash —
+the same design as the link-loss erasure coin (models/linkloss.py):
+
+    h(node, t, j)   = mix32(seed ^ node*C_NODE ^ t*C_TICK ^ j*C_PICK)
+    pick(node,t,j)  = h % degree(node)        # index into the sorted
+                                              # neighbor row (CSR/ELL order)
+
+with ``j`` the pick slot (0 for push-pull's single partner; 0..k-1 for
+fanout k) and mix32 the splitmix32 finalizer. Every engine — single-device
+jnp, shard_map over a mesh, and the plain-numpy oracles — evaluates the
+same spec, so a node's partner sequence is identical no matter how the
+graph is sharded; that is what makes seeded (not just pinned-override)
+cross-engine parity testable. The modulo bias is ~degree/2^32 — nil for
+any real graph.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_C_NODE = 0x9E3779B1
+_C_TICK = 0x85EBCA77
+_C_PICK = 0xC2B2AE3D
+_M1 = 0x7FEB352D
+_M2 = 0x846CA68B
+_MASK = 0xFFFFFFFF
+
+
+def pick_index_np(node, tick, pick, degree, seed: int) -> np.ndarray:
+    """Reference (numpy) evaluation: neighbor-slot index in [0, degree).
+    Shapes broadcast; degree 0 yields 0 (callers gate empty rows)."""
+    h = (
+        np.uint64(seed & _MASK)
+        ^ (np.asarray(node, np.uint64) * np.uint64(_C_NODE))
+        ^ (np.asarray(tick, np.uint64) * np.uint64(_C_TICK))
+        ^ (np.asarray(pick, np.uint64) * np.uint64(_C_PICK))
+    ) & np.uint64(_MASK)
+    h ^= h >> np.uint64(16)
+    h = (h * np.uint64(_M1)) & np.uint64(_MASK)
+    h ^= h >> np.uint64(15)
+    h = (h * np.uint64(_M2)) & np.uint64(_MASK)
+    h ^= h >> np.uint64(16)
+    deg = np.maximum(np.asarray(degree, np.uint64), 1)
+    return (h % deg).astype(np.int64)
+
+
+def pick_index_jnp(node, tick, pick, degree, seed):
+    """jnp evaluation — bit-identical to pick_index_np (uint32 wraparound
+    replaces the uint64+mask dance). ``tick`` and ``seed`` may be traced
+    scalars."""
+    import jax.numpy as jnp
+
+    h = (
+        jnp.asarray(seed).astype(jnp.uint32)
+        ^ (jnp.asarray(node).astype(jnp.uint32) * jnp.uint32(_C_NODE))
+        ^ (jnp.asarray(tick).astype(jnp.uint32) * jnp.uint32(_C_TICK))
+        ^ (jnp.asarray(pick).astype(jnp.uint32) * jnp.uint32(_C_PICK))
+    )
+    h ^= h >> 16
+    h = h * jnp.uint32(_M1)
+    h ^= h >> 15
+    h = h * jnp.uint32(_M2)
+    h ^= h >> 16
+    deg = jnp.maximum(jnp.asarray(degree), 1).astype(jnp.uint32)
+    return (h % deg).astype(jnp.int32)

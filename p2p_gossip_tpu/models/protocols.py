@@ -37,19 +37,22 @@ from p2p_gossip_tpu.models.churn import (
     up_mask_jnp,
 )
 from p2p_gossip_tpu.models.generation import Schedule
+from p2p_gossip_tpu.models.partnersel import pick_index_jnp
 from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
 from p2p_gossip_tpu.ops.segment import scatter_or
 from p2p_gossip_tpu.utils.stats import NodeStats
 
 
-def _select_partners(key, ell_idx, ell_delay, degree):
-    """One uniform-random neighbor (and its edge delay) per node."""
+def _select_partners(seed, t, ell_idx, ell_delay, degree, node_ids=None):
+    """One uniform-random neighbor (and its edge delay) per row via the
+    counter-based pick hash (models/partnersel.py) — identical choices on
+    every engine and shard layout. ``node_ids`` gives each row's global
+    node id (defaults to 0..n-1; the sharded engine passes its row ids)."""
     n, _ = ell_idx.shape
-    k = jax.random.randint(
-        key, (n,), minval=0, maxval=jnp.maximum(degree, 1)
-    )
     rows = jnp.arange(n)
+    ids = rows if node_ids is None else node_ids
+    k = pick_index_jnp(ids, t, 0, degree, seed)
     return ell_idx[rows, k], ell_delay[rows, k]
 
 
@@ -61,7 +64,7 @@ def _run_pushpull(
     dg: DeviceGraph,
     origins: jnp.ndarray,
     gen_ticks: jnp.ndarray,
-    key: jnp.ndarray,
+    seed: jnp.ndarray,                # uint32 scalar — partner-pick stream
     partners_override: jnp.ndarray,   # (horizon, N) int32 or (0,) when unused
     churn=None,                       # optional ((N, K), (N, K)) intervals
     *,
@@ -91,14 +94,13 @@ def _run_pushpull(
         elif dg.uniform_delay is not None:
             # DeviceGraph stages a placeholder delay array on the fast path —
             # the real delay is the static scalar.
-            key_t = jax.random.fold_in(key, t)
             partners, _ = _select_partners(
-                key_t, dg.ell_idx, jnp.zeros_like(dg.ell_idx), dg.degree
+                seed, t, dg.ell_idx, jnp.zeros_like(dg.ell_idx), dg.degree
             )
             delay = jnp.full((n,), dg.uniform_delay, dtype=jnp.int32)
         else:
             partners, delay = _select_partners(
-                jax.random.fold_in(key, t), dg.ell_idx, dg.ell_delay, dg.degree
+                seed, t, dg.ell_idx, dg.ell_delay, dg.degree
             )
         # Partner state as of `delay` ticks ago (delay lines over seen).
         flat = hist.reshape(ring * n, w)
@@ -109,10 +111,12 @@ def _run_pushpull(
         # (models/churn.py); an attempted exchange loses each direction
         # independently to the per-link erasure coin (models/linkloss.py).
         rows = jnp.arange(n, dtype=jnp.int32)
-        attempted = jnp.ones((n,), dtype=bool)
+        # Degree-0 rows have no neighbors to exchange with (their pick
+        # would read ELL zero-padding) — same gate as the sharded engine.
+        attempted = dg.degree > 0
         if churn is not None:
             up = up_mask_jnp(churn[0], churn[1], t)
-            attempted = up & up[partners]
+            attempted = attempted & up & up[partners]
         pull_ok = push_ok = attempted
         if loss is not None:
             from p2p_gossip_tpu.models.linkloss import drop_mask_jnp
@@ -232,7 +236,7 @@ def _run_partnered_sim(
         if partners_override is not None
         else jnp.zeros((0,), dtype=jnp.int32)
     )
-    key = jax.random.PRNGKey(seed)
+    seed = jnp.uint32(seed & 0xFFFFFFFF)
     churn_dev = churn_to_device(churn)
     loss_cfg = loss.static_cfg if loss is not None else None
 
@@ -245,7 +249,7 @@ def _run_partnered_sim(
             dg,
             jnp.asarray(origins),
             jnp.asarray(gen_ticks),
-            key,
+            seed,
             override,
             churn_dev,
             chunk_size=chunk_size,
@@ -295,10 +299,10 @@ def pushpull_oracle(
     for t in range(horizon_ticks):
         old = hist[(t - 1) % 2]
         p = partners[t]
-        attempted = np.ones(n, dtype=bool)
+        attempted = graph.degree > 0  # same degree-0 gate as the engines
         if churn is not None:
             up = churn.up_mask(t)
-            attempted = up & up[p]
+            attempted = attempted & up & up[p]
         pull_ok = push_ok = attempted
         if loss is not None:
             pull_ok = attempted & ~drop_mask_np(
@@ -331,19 +335,51 @@ def pushpull_oracle(
     )
 
 
+def seeded_partners(
+    graph: Graph, horizon: int, seed: int, fanout: int | None = None
+) -> np.ndarray:
+    """Host-side replication of the engines' counter-based partner picks
+    (models/partnersel.py): the exact partners a seeded run selects, as
+    (horizon, N) for push-pull or (horizon, N, fanout) for fanout push.
+    Feeding these to the numpy oracles reproduces a seeded engine run
+    bit-for-bit (uniform one-tick delay), which is what makes *seeded* —
+    not just pinned-override — cross-engine parity testable."""
+    from p2p_gossip_tpu.models.partnersel import pick_index_np
+
+    ell_idx, _ = graph.ell()
+    deg = graph.degree
+    rows = np.arange(graph.n)
+    ticks = np.arange(horizon)
+    if fanout is None:
+        k = pick_index_np(rows[None, :], ticks[:, None], 0, deg[None, :], seed)
+        return ell_idx[rows[None, :], k].astype(np.int32)
+    picks = np.arange(fanout)
+    k = pick_index_np(
+        rows[None, :, None],
+        ticks[:, None, None],
+        picks[None, None, :],
+        deg[None, :, None],
+        seed,
+    )
+    return ell_idx[rows[None, :, None], k].astype(np.int32)
+
+
 # ---------------------------------------------------------------------------
 # Fanout-limited push ("rumor mongering")
 # ---------------------------------------------------------------------------
 
-def _select_fanout_partners(key, ell_idx, ell_delay, degree, fanout):
-    """``fanout`` independent uniform neighbor picks per node (with
+def _select_fanout_partners(
+    seed, t, ell_idx, ell_delay, degree, fanout, node_ids=None
+):
+    """``fanout`` independent uniform neighbor picks per row (with
     replacement — duplicate picks are independent sends), plus each picked
-    edge's delay. Returns ((N, k) partners, (N, k) delays)."""
+    edge's delay, via the counter-based pick hash (models/partnersel.py).
+    ``node_ids`` as in `_select_partners`. Returns ((N, k), (N, k))."""
     n, _ = ell_idx.shape
-    kidx = jax.random.randint(
-        key, (n, fanout), minval=0, maxval=jnp.maximum(degree, 1)[:, None]
-    )
     rows = jnp.arange(n)[:, None]
+    ids = rows if node_ids is None else node_ids[:, None]
+    picks = jnp.arange(fanout, dtype=jnp.int32)[None, :]
+    kidx = pick_index_jnp(ids, t, picks, degree[:, None], seed)
     return ell_idx[rows, kidx], ell_delay[rows, kidx]
 
 
@@ -355,7 +391,7 @@ def _run_pushk(
     dg: DeviceGraph,
     origins: jnp.ndarray,
     gen_ticks: jnp.ndarray,
-    key: jnp.ndarray,
+    seed: jnp.ndarray,                # uint32 scalar — partner-pick stream
     partners_override: jnp.ndarray,   # (horizon, N, k) int32 or (0,) unused
     churn=None,                       # optional ((N, K), (N, K)) intervals
     *,
@@ -386,24 +422,25 @@ def _run_pushk(
             delay = jnp.ones((n, fanout), dtype=jnp.int32)
         elif dg.uniform_delay is not None:
             partners, _ = _select_fanout_partners(
-                jax.random.fold_in(key, t), dg.ell_idx,
-                jnp.zeros_like(dg.ell_idx), dg.degree, fanout,
+                seed, t, dg.ell_idx, jnp.zeros_like(dg.ell_idx), dg.degree,
+                fanout,
             )
             delay = jnp.full((n, fanout), dg.uniform_delay, dtype=jnp.int32)
         else:
             partners, delay = _select_fanout_partners(
-                jax.random.fold_in(key, t), dg.ell_idx, dg.ell_delay,
-                dg.degree, fanout,
+                seed, t, dg.ell_idx, dg.ell_delay, dg.degree, fanout,
             )
         # Each pick pushes the sender's FRONTIER (newly|gen) as of `delay`
         # ticks ago — the same delay-line convention as push-pull above.
         flat = hist.reshape(ring * n, w)
         slot = jnp.mod(t - delay, ring)               # (N, k)
         payload = flat[slot * n + rows[:, None]]      # (N, k, W)
-        attempted = jnp.ones((n, fanout), dtype=bool)
+        # Degree-0 rows have no neighbors to push to — same gate as the
+        # sharded engine.
+        attempted = jnp.broadcast_to((dg.degree > 0)[:, None], (n, fanout))
         if churn is not None:
             up = up_mask_jnp(churn[0], churn[1], t)
-            attempted = up[:, None] & up[partners]
+            attempted = attempted & up[:, None] & up[partners]
         push_ok = attempted
         if loss is not None:
             from p2p_gossip_tpu.models.linkloss import drop_mask_jnp
@@ -518,10 +555,11 @@ def pushk_oracle(
     for t in range(horizon_ticks):
         front_old = hist[(t - 1) % 2]
         p = partners[t]
-        attempted = np.ones((n, k), dtype=bool)
+        # Same degree-0 gate as the engines.
+        attempted = np.broadcast_to((graph.degree > 0)[:, None], (n, k)).copy()
         if churn is not None:
             up = churn.up_mask(t)
-            attempted = up[:, None] & up[p]
+            attempted = attempted & up[:, None] & up[p]
         push_ok = attempted
         if loss is not None:
             push_ok = attempted & ~drop_mask_np(

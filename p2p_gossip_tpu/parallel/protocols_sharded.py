@@ -1,0 +1,296 @@
+"""Multi-chip random-partner protocols: shard_map push-pull and fanout push.
+
+Scales models/protocols.py the way engine_sharded.py scales the flood
+engine: graph rows, seen state, and counters shard along ``nodes``;
+independent share chunks along ``shares``. The partner-pick hash
+(models/partnersel.py) is a pure function of (global node id, round, pick,
+seed), so every shard selects exactly the partners the single-device
+engine would — seeded sharded runs are bitwise-identical to seeded
+single-device runs, which the tests assert.
+
+Collectives per round, riding ICI:
+
+- the **push** direction scatters rows into arbitrary global partners, so
+  each shard scatter-ORs into a global-width buffer and the shards combine
+  with an all_to_all "reduce-scatter-OR" (split the buffer by destination
+  shard, exchange, OR the received stack) — each device ends with only its
+  own rows;
+- the state **exchange**: each shard all_gathers its updated local state
+  (seen for push-pull, newly-frontier for fanout push) into the global
+  history ring that next round's delay-line reads index.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from p2p_gossip_tpu.engine.sync import MIN_CHUNK_SHARES
+from p2p_gossip_tpu.models.churn import effective_generated, up_mask_jnp
+from p2p_gossip_tpu.models.generation import Schedule
+from p2p_gossip_tpu.models.linkloss import drop_mask_jnp
+from p2p_gossip_tpu.models.partnersel import pick_index_jnp
+from p2p_gossip_tpu.models.topology import Graph
+from p2p_gossip_tpu.ops import bitmask
+from p2p_gossip_tpu.ops.segment import scatter_or
+from p2p_gossip_tpu.parallel.mesh import NODES_AXIS, SHARES_AXIS, pad_to_multiple
+from p2p_gossip_tpu.utils.stats import NodeStats
+
+
+def _reduce_scatter_or(pushed_global: jnp.ndarray, n_shards: int, n_loc: int):
+    """(n_padded, W) per-device push buffers -> (n_loc, W) OR of every
+    device's pushes into THIS device's rows. all_to_all moves each
+    destination shard's slice to its owner; the OR folds the stack."""
+    w = pushed_global.shape[-1]
+    parts = pushed_global.reshape(n_shards, n_loc, w)
+    recv = lax.all_to_all(parts, NODES_AXIS, split_axis=0, concat_axis=0)
+    return lax.reduce(recv, jnp.uint32(0), lax.bitwise_or, (0,))
+
+
+@functools.lru_cache(maxsize=32)
+def build_partnered_runner(
+    mesh: Mesh,
+    protocol: str,            # "pushpull" | "pushk"
+    n_padded: int,
+    ring_size: int,
+    chunk_size: int,
+    horizon: int,
+    fanout: int = 1,
+    loss: tuple | None = None,
+):
+    """Compile the per-pass runner for a random-partner protocol over the
+    mesh. Memoized on mesh/shapes like engine_sharded.build_sharded_runner.
+
+    Counters come back stacked per share-shard — (n_share_shards, n_padded)
+    int32 received and uint32 sent lo/hi pairs — and the host folds them in
+    int64 (a psum of the raw u64 halves would drop carries)."""
+    if protocol not in ("pushpull", "pushk"):
+        raise ValueError(f"unknown protocol {protocol!r}")
+    n_share_shards = mesh.shape[SHARES_AXIS]
+    n_node_shards = mesh.shape[NODES_AXIS]
+    n_loc = n_padded // n_node_shards
+    w = bitmask.num_words(chunk_size)
+    k = fanout if protocol == "pushk" else 1
+
+    def pass_fn(
+        ell_idx, ell_delay, degree, churn_start, churn_end,
+        origins, gen_ticks, seed,
+    ):
+        # Local: ell_* (n_loc, dmax), degree (n_loc,), origins/gen_ticks
+        # (chunk_size,). Replicated: churn_* (n_padded, K) — partner up
+        # checks need every node's intervals — and the seed scalar.
+        row_offset = lax.axis_index(NODES_AXIS).astype(jnp.int32) * n_loc
+        node_ids = row_offset + jnp.arange(n_loc, dtype=jnp.int32)
+        slots = jnp.arange(chunk_size, dtype=jnp.int32)
+        rows_l = jnp.arange(n_loc, dtype=jnp.int32)
+        live_row = degree > 0  # ELL padding rows never exchange
+
+        state = (
+            jnp.zeros((n_loc, w), dtype=jnp.uint32),              # seen
+            jnp.zeros((ring_size, n_padded, w), dtype=jnp.uint32),  # hist
+            jnp.zeros((n_loc,), dtype=jnp.int32),                 # received
+            jnp.zeros((n_loc,), dtype=jnp.uint32),                # sent lo
+            jnp.zeros((n_loc,), dtype=jnp.uint32),                # sent hi
+        )
+
+        def body(t, state):
+            seen, hist, received, sent_lo, sent_hi = state
+            t = jnp.int32(t)
+            if protocol == "pushpull":
+                kidx = pick_index_jnp(node_ids, t, 0, degree, seed)
+                partners = ell_idx[rows_l, kidx]          # (n_loc,) global
+                delay = ell_delay[rows_l, kidx]
+                pick_shape = (n_loc,)
+            else:
+                picks = jnp.arange(k, dtype=jnp.int32)[None, :]
+                kidx = pick_index_jnp(
+                    node_ids[:, None], t, picks, degree[:, None], seed
+                )
+                partners = ell_idx[rows_l[:, None], kidx]  # (n_loc, k)
+                delay = ell_delay[rows_l[:, None], kidx]
+                pick_shape = (n_loc, k)
+
+            flat = hist.reshape(ring_size * n_padded, w)
+            slot = jnp.mod(t - delay, ring_size)
+            if protocol == "pushpull":
+                remote = flat[slot * n_padded + partners]          # pull
+                my_old = flat[slot * n_padded + node_ids]          # push
+            else:
+                my_old = flat[slot * n_padded + node_ids[:, None]]  # (n_loc,k,W)
+
+            up = up_mask_jnp(churn_start, churn_end, t)   # (n_padded,)
+            self_ids = (
+                node_ids if protocol == "pushpull" else node_ids[:, None]
+            )
+            attempted = (
+                up[self_ids] & up[partners]
+                & (live_row if protocol == "pushpull" else live_row[:, None])
+            )
+            pull_ok = push_ok = attempted
+            if loss is not None:
+                thr, lseed = loss
+                push_ok = attempted & ~drop_mask_jnp(
+                    self_ids, partners, t, thr, lseed
+                )
+                if protocol == "pushpull":
+                    pull_ok = attempted & ~drop_mask_jnp(
+                        partners, node_ids, t, thr, lseed
+                    )
+
+            if protocol == "pushpull":
+                remote = jnp.where(pull_ok[:, None], remote, jnp.uint32(0))
+                pushed = scatter_or(
+                    n_padded, partners,
+                    jnp.where(push_ok[:, None], my_old, jnp.uint32(0)),
+                )
+                sent_add = jnp.where(
+                    attempted, bitmask.popcount_rows(my_old), 0
+                )
+            else:
+                payload_ok = jnp.where(
+                    push_ok[..., None], my_old, jnp.uint32(0)
+                )
+                pushed = scatter_or(
+                    n_padded, partners.reshape(-1),
+                    payload_ok.reshape(n_loc * k, w),
+                )
+                pick_cnt = bitmask.popcount_rows(
+                    my_old.reshape(n_loc * k, w)
+                ).reshape(n_loc, k)
+                remote = jnp.uint32(0)
+                sent_add = jnp.sum(jnp.where(attempted, pick_cnt, 0), axis=1)
+
+            pushed_local = _reduce_scatter_or(pushed, n_node_shards, n_loc)
+            sent_lo, sent_hi = bitmask.add_u64(sent_lo, sent_hi, sent_add)
+
+            local_origin_rows = origins - row_offset
+            in_shard = (local_origin_rows >= 0) & (local_origin_rows < n_loc)
+            gen_active = (gen_ticks == t) & in_shard & up[origins]
+            gen_bits = bitmask.slot_scatter(
+                n_loc, w, local_origin_rows, slots, gen_active
+            )
+
+            if protocol == "pushpull":
+                incoming = (remote | pushed_local) & ~seen
+                received = received + bitmask.popcount_rows(incoming)
+                seen = seen | incoming | gen_bits
+                exchange = seen                       # hist holds seen-state
+            else:
+                newly = pushed_local & ~seen
+                received = received + bitmask.popcount_rows(newly)
+                seen = seen | newly | gen_bits
+                exchange = newly | gen_bits           # hist holds frontier
+            full = lax.all_gather(exchange, NODES_AXIS, axis=0, tiled=True)
+            hist = hist.at[jnp.mod(t, ring_size)].set(full)
+            return (seen, hist, received, sent_lo, sent_hi)
+
+        seen, _, received, sent_lo, sent_hi = lax.fori_loop(
+            0, horizon, body, state
+        )
+        # Stack per share-shard (host folds in int64; psum of u32 halves
+        # would drop carries).
+        return received[None], sent_lo[None], sent_hi[None]
+
+    mapped = shard_map(
+        pass_fn,
+        mesh=mesh,
+        in_specs=(
+            P(NODES_AXIS, None),  # ell_idx
+            P(NODES_AXIS, None),  # ell_delay
+            P(NODES_AXIS),        # degree
+            P(),                  # churn_start (replicated: partner checks)
+            P(),                  # churn_end
+            P(SHARES_AXIS),       # origins
+            P(SHARES_AXIS),       # gen_ticks
+            P(),                  # seed
+        ),
+        out_specs=(
+            P(SHARES_AXIS, NODES_AXIS),
+            P(SHARES_AXIS, NODES_AXIS),
+            P(SHARES_AXIS, NODES_AXIS),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(mapped), n_share_shards * chunk_size
+
+
+def run_sharded_partnered_sim(
+    graph: Graph,
+    schedule: Schedule,
+    horizon_ticks: int,
+    mesh: Mesh,
+    protocol: str = "pushpull",
+    fanout: int = 2,
+    ell_delays: np.ndarray | None = None,
+    constant_delay: int = 1,
+    chunk_size: int = 4096,
+    seed: int = 0,
+    churn=None,
+    loss=None,
+) -> NodeStats:
+    """Drop-in counterpart of run_pushpull_sim / run_pushk_sim on a device
+    mesh: identical per-node counters for any mesh shape (the counter-based
+    partner hash keys on global node ids, so shard boundaries change
+    nothing), including under churn and link loss.
+
+    ``chunk_size`` is per share-shard, as in run_sharded_sim.
+    """
+    if protocol not in ("pushpull", "pushk"):
+        raise ValueError(f"unknown protocol {protocol!r}")
+    n_node_shards = mesh.shape[NODES_AXIS]
+    chunk_size = min(chunk_size, max(MIN_CHUNK_SHARES, schedule.num_shares))
+    chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
+
+    ell_idx, ell_mask = graph.ell()
+    if ell_delays is None:
+        ell_delays = np.full(ell_idx.shape, constant_delay, dtype=np.int32)
+    ring = (int(ell_delays.max()) if ell_delays.size else 1) + 1
+    ell_idx = pad_to_multiple(ell_idx, n_node_shards)
+    ell_delays = pad_to_multiple(ell_delays, n_node_shards, fill=1)
+    degree = pad_to_multiple(graph.degree.astype(np.int32), n_node_shards)
+    n_padded = ell_idx.shape[0]
+    if churn is not None:
+        churn_start = pad_to_multiple(churn.down_start, n_node_shards)
+        churn_end = pad_to_multiple(churn.down_end, n_node_shards)
+    else:
+        churn_start = np.zeros((n_padded, 1), dtype=np.int32)
+        churn_end = np.zeros((n_padded, 1), dtype=np.int32)
+
+    runner, pass_size = build_partnered_runner(
+        mesh, protocol, n_padded, ring, chunk_size, horizon_ticks,
+        fanout if protocol == "pushk" else 1,
+        loss.static_cfg if loss is not None else None,
+    )
+    seed_arr = np.uint32(seed & 0xFFFFFFFF)
+
+    received = np.zeros(n_padded, dtype=np.int64)
+    sent = np.zeros(n_padded, dtype=np.int64)
+    for chunk in schedule.chunk(pass_size) or [schedule]:
+        origins, gen_ticks = chunk.padded(pass_size, horizon_ticks)
+        r, s_lo, s_hi = runner(
+            ell_idx, ell_delays, degree, churn_start, churn_end,
+            origins, gen_ticks, seed_arr,
+        )
+        received += np.asarray(r, dtype=np.int64).sum(axis=0)
+        sent += bitmask.combine_u64(
+            jnp.asarray(s_lo), jnp.asarray(s_hi)
+        ).reshape(-1, n_padded).sum(axis=0)
+
+    received = received[: graph.n]
+    sent = sent[: graph.n]
+    generated = effective_generated(schedule, horizon_ticks, churn)
+    return NodeStats(
+        generated=generated,
+        received=received,
+        forwarded=received.copy(),
+        sent=sent,
+        processed=generated + received,
+        degree=graph.degree.astype(np.int64),
+    )

@@ -443,9 +443,12 @@ def run(argv=None) -> int:
             return 2
         return _run_flood_coverage_cli(args, g, horizon, delays, churn, loss)
 
-    if args.protocol in ("pushpull", "pushk") and args.backend != "tpu":
+    if args.protocol in ("pushpull", "pushk") and args.backend not in (
+        "tpu", "sharded"
+    ):
         print(
-            f"error: --protocol {args.protocol} requires --backend tpu",
+            f"error: --protocol {args.protocol} requires --backend "
+            "tpu|sharded",
             file=sys.stderr,
         )
         return 2
@@ -468,7 +471,23 @@ def run(argv=None) -> int:
         return 2
 
     t0 = time.perf_counter()
-    if args.protocol == "pushpull":
+    if args.protocol in ("pushpull", "pushk") and args.backend == "sharded":
+        from p2p_gossip_tpu.parallel.mesh import make_mesh
+        from p2p_gossip_tpu.parallel.protocols_sharded import (
+            run_sharded_partnered_sim,
+        )
+
+        mesh = make_mesh(args.meshNodes or None, args.meshShares)
+        print(
+            f"Mesh: {mesh.shape['shares']} share-shards x "
+            f"{mesh.shape['nodes']} node-shards"
+        )
+        stats = run_sharded_partnered_sim(
+            g, sched, horizon, mesh, protocol=args.protocol,
+            fanout=args.fanout, ell_delays=delays, seed=args.seed,
+            chunk_size=args.chunkSize, churn=churn, loss=loss,
+        )
+    elif args.protocol == "pushpull":
         from p2p_gossip_tpu.models.protocols import run_pushpull_sim
 
         stats, _ = run_pushpull_sim(

@@ -22,21 +22,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # The first `jax.devices()` call initializes EVERY registered backend —
 # dialing the plugin's TPU tunnel from CPU-only tests, and hanging the
-# whole suite when the tunnel is down. Importing jax is safe (init is
-# lazy); deregister the plugin's backend factory before anything triggers
-# init. Best-effort via private jax internals: on a jax version that moves
-# them, degrade to the pre-existing behavior (tests need a live tunnel)
-# rather than failing collection.
+# whole suite when the tunnel is down. Deregister the plugin's backend
+# factory before anything triggers init (JAX_PLATFORMS=cpu was set above,
+# so the shared helper applies).
 import jax  # noqa: E402
 
-try:
-    import jax._src.xla_bridge as _xb
+from p2p_gossip_tpu.utils.platform import (  # noqa: E402
+    force_cpu_backend_if_requested,
+)
 
-    getattr(_xb, "_backend_factories", {}).pop("axon", None)
-except Exception:
-    pass
-# The plugin also pins jax_platforms via config (which outranks the
-# JAX_PLATFORMS env var set above) — pin it back.
-jax.config.update("jax_platforms", "cpu")
+force_cpu_backend_if_requested()
 
 jax.config.update("jax_default_device", jax.devices("cpu")[0])

@@ -169,3 +169,20 @@ def test_pushk_churn_loss_matches_oracle():
         g, sched, horizon, fanout=fanout, partners_override=picks, churn=churn
     )
     assert got.received[5] == 0 and got.sent[5] == 0
+
+
+def test_pushk_seeded_run_matches_oracle_via_seeded_partners():
+    from p2p_gossip_tpu.models.protocols import seeded_partners
+
+    g = pg.erdos_renyi(50, 0.12, seed=4)
+    sched = Schedule(
+        g.n,
+        np.array([0, 9, 21], dtype=np.int32),
+        np.array([0, 1, 4], dtype=np.int32),
+    )
+    horizon, seed, fanout = 15, 42, 3
+    got, _ = run_pushk_sim(g, sched, horizon, fanout=fanout, seed=seed)
+    want = pushk_oracle(
+        g, sched, horizon, seeded_partners(g, horizon, seed, fanout=fanout)
+    )
+    assert got.equal_counts(want)

@@ -162,3 +162,23 @@ def test_pushpull_churn_loss_matches_oracle():
         g, sched, horizon, partners_override=partners, churn=churn
     )
     assert got.received[5] == 0 and got.sent[5] == 0
+
+
+def test_pushpull_seeded_run_matches_oracle_via_seeded_partners():
+    """The counter-based pick hash makes SEEDED runs reproducible on the
+    host: the oracle fed with seeded_partners must equal the engine's own
+    seeded partner selection (uniform one-tick delay)."""
+    from p2p_gossip_tpu.models.protocols import seeded_partners
+
+    g = pg.erdos_renyi(50, 0.12, seed=4)
+    sched = Schedule(
+        g.n,
+        np.array([0, 9, 21], dtype=np.int32),
+        np.array([0, 1, 4], dtype=np.int32),
+    )
+    horizon, seed = 15, 42
+    got, _ = run_pushpull_sim(g, sched, horizon, seed=seed)
+    want = pushpull_oracle(
+        g, sched, horizon, seeded_partners(g, horizon, seed)
+    )
+    assert got.equal_counts(want)

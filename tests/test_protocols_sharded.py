@@ -1,0 +1,191 @@
+"""Sharded random-partner protocols: mesh runs must equal the
+single-device engines bit-for-bit (the counter-based partner hash keys on
+global node ids, so shard boundaries change nothing)."""
+
+import numpy as np
+import pytest
+
+import p2p_gossip_tpu as pg
+from p2p_gossip_tpu.models.generation import Schedule, single_share_schedule
+from p2p_gossip_tpu.models.latency import lognormal_delays
+from p2p_gossip_tpu.models.protocols import run_pushk_sim, run_pushpull_sim
+from p2p_gossip_tpu.parallel.mesh import make_mesh
+from p2p_gossip_tpu.parallel.protocols_sharded import (
+    run_sharded_partnered_sim,
+)
+
+
+MESHES = [(1, 8), (2, 4), (4, 2), (8, 1)]
+
+
+def _sched(n):
+    return Schedule(
+        n,
+        np.array([0, 9, 21, 33], dtype=np.int32),
+        np.array([0, 1, 4, 6], dtype=np.int32),
+    )
+
+
+@pytest.mark.parametrize("shares,nodes", MESHES)
+def test_sharded_pushpull_matches_single_device(shares, nodes):
+    g = pg.erdos_renyi(70, 0.1, seed=3)
+    sched = _sched(g.n)
+    horizon, seed = 14, 5
+    want, _ = run_pushpull_sim(g, sched, horizon, seed=seed)
+    mesh = make_mesh(nodes, shares)
+    got = run_sharded_partnered_sim(
+        g, sched, horizon, mesh, protocol="pushpull", seed=seed
+    )
+    assert got.equal_counts(want), (shares, nodes)
+
+
+@pytest.mark.parametrize("shares,nodes", [(2, 4), (1, 8)])
+def test_sharded_pushk_matches_single_device(shares, nodes):
+    g = pg.erdos_renyi(70, 0.1, seed=3)
+    sched = _sched(g.n)
+    horizon, seed, fanout = 14, 5, 3
+    want, _ = run_pushk_sim(g, sched, horizon, fanout=fanout, seed=seed)
+    mesh = make_mesh(nodes, shares)
+    got = run_sharded_partnered_sim(
+        g, sched, horizon, mesh, protocol="pushk", fanout=fanout, seed=seed
+    )
+    assert got.equal_counts(want), (shares, nodes)
+
+
+def test_sharded_pushpull_with_delays_matches_single_device():
+    g = pg.ring_graph(48)
+    d = lognormal_delays(g, mean_ticks=2.0, sigma=0.5, max_ticks=4, seed=5)
+    sched = single_share_schedule(g.n, origin=0)
+    horizon, seed = 30, 7
+    want, _ = run_pushpull_sim(g, sched, horizon, ell_delays=d, seed=seed)
+    got = run_sharded_partnered_sim(
+        g, sched, horizon, make_mesh(4, 2), protocol="pushpull",
+        ell_delays=d, seed=seed,
+    )
+    assert got.equal_counts(want)
+
+
+def test_sharded_pushpull_churn_loss_matches_single_device():
+    from p2p_gossip_tpu.models.churn import ChurnModel
+    from p2p_gossip_tpu.models.linkloss import LinkLossModel
+
+    g = pg.erdos_renyi(40, 0.15, seed=3)
+    sched = single_share_schedule(g.n, origin=0)
+    horizon, seed = 20, 11
+    down_start = np.zeros((g.n, 1), dtype=np.int32)
+    down_end = np.zeros((g.n, 1), dtype=np.int32)
+    down_start[5, 0], down_end[5, 0] = 0, horizon
+    down_start[11, 0], down_end[11, 0] = 5, 15
+    churn = ChurnModel(n=g.n, down_start=down_start, down_end=down_end)
+    loss = LinkLossModel(0.3, seed=9)
+    for kw in (dict(churn=churn), dict(loss=loss),
+               dict(churn=churn, loss=loss)):
+        want, _ = run_pushpull_sim(g, sched, horizon, seed=seed, **kw)
+        got = run_sharded_partnered_sim(
+            g, sched, horizon, make_mesh(2, 4), protocol="pushpull",
+            seed=seed, **kw,
+        )
+        assert got.equal_counts(want), kw
+
+
+def test_sharded_pushk_churn_loss_matches_single_device():
+    from p2p_gossip_tpu.models.churn import ChurnModel
+    from p2p_gossip_tpu.models.linkloss import LinkLossModel
+
+    g = pg.erdos_renyi(40, 0.15, seed=3)
+    sched = single_share_schedule(g.n, origin=0)
+    horizon, seed = 20, 11
+    down_start = np.zeros((g.n, 1), dtype=np.int32)
+    down_end = np.zeros((g.n, 1), dtype=np.int32)
+    down_start[7, 0], down_end[7, 0] = 2, 12
+    churn = ChurnModel(n=g.n, down_start=down_start, down_end=down_end)
+    loss = LinkLossModel(0.25, seed=4)
+    want, _ = run_pushk_sim(
+        g, sched, horizon, fanout=2, seed=seed, churn=churn, loss=loss
+    )
+    got = run_sharded_partnered_sim(
+        g, sched, horizon, make_mesh(2, 4), protocol="pushk", fanout=2,
+        seed=seed, churn=churn, loss=loss,
+    )
+    assert got.equal_counts(want)
+
+
+def test_sharded_partnered_chunked_counters_additive():
+    g = pg.erdos_renyi(40, 0.15, seed=8)
+    sched = Schedule(
+        g.n,
+        np.arange(100, dtype=np.int32) % g.n,
+        (np.arange(100, dtype=np.int32) % 5).astype(np.int32),
+    )
+    mesh = make_mesh(4, 2)
+    whole = run_sharded_partnered_sim(
+        g, sched, 18, mesh, protocol="pushpull", seed=9, chunk_size=4096
+    )
+    chunked = run_sharded_partnered_sim(
+        g, sched, 18, mesh, protocol="pushpull", seed=9, chunk_size=32
+    )
+    assert chunked.equal_counts(whole)
+    want, _ = run_pushpull_sim(g, sched, 18, seed=9, chunk_size=64)
+    assert whole.equal_counts(want)
+
+
+def test_sharded_partnered_rejects_unknown_protocol():
+    g = pg.erdos_renyi(16, 0.3, seed=0)
+    sched = single_share_schedule(g.n, origin=0)
+    with pytest.raises(ValueError):
+        run_sharded_partnered_sim(
+            g, sched, 4, make_mesh(2, 4), protocol="pull"
+        )
+
+
+def test_isolated_node_exchanges_nothing_on_every_engine():
+    """Degree-0 rows must be gated identically everywhere: a pick on an
+    empty ELL row reads zero-padding (node 0), so without the gate an
+    isolated node would exchange over a nonexistent edge — and the
+    single-device and sharded engines would disagree."""
+    from p2p_gossip_tpu.models.protocols import (
+        pushk_oracle,
+        pushpull_oracle,
+        seeded_partners,
+    )
+    from p2p_gossip_tpu.models.topology import Graph
+
+    # Ring over nodes 0..6 plus isolated node 7.
+    n = 8
+    ring = 7
+    indptr = np.zeros(n + 1, dtype=np.int64)
+    indices = []
+    for i in range(ring):
+        indices += sorted([(i - 1) % ring, (i + 1) % ring])
+        indptr[i + 1] = indptr[i] + 2
+    indptr[ring + 1 :] = indptr[ring]
+    g = Graph(n=n, indptr=indptr, indices=np.array(indices, dtype=np.int32))
+    assert g.degree[7] == 0
+    sched = Schedule(
+        g.n,
+        np.array([0, 7], dtype=np.int32),   # node 7 generates one share too
+        np.array([0, 0], dtype=np.int32),
+    )
+    horizon, seed = 12, 3
+    single_pp, _ = run_pushpull_sim(g, sched, horizon, seed=seed)
+    assert single_pp.sent[7] == 0 and single_pp.received[7] == 0
+    want_pp = pushpull_oracle(
+        g, sched, horizon, seeded_partners(g, horizon, seed)
+    )
+    assert single_pp.equal_counts(want_pp)
+    sharded_pp = run_sharded_partnered_sim(
+        g, sched, horizon, make_mesh(2, 4), protocol="pushpull", seed=seed
+    )
+    assert sharded_pp.equal_counts(single_pp)
+
+    single_pk, _ = run_pushk_sim(g, sched, horizon, fanout=2, seed=seed)
+    assert single_pk.sent[7] == 0 and single_pk.received[7] == 0
+    want_pk = pushk_oracle(
+        g, sched, horizon, seeded_partners(g, horizon, seed, fanout=2)
+    )
+    assert single_pk.equal_counts(want_pk)
+    sharded_pk = run_sharded_partnered_sim(
+        g, sched, horizon, make_mesh(2, 4), protocol="pushk", fanout=2,
+        seed=seed,
+    )
+    assert sharded_pk.equal_counts(single_pk)
