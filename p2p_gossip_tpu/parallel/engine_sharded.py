@@ -51,9 +51,15 @@ def _padded_device_graph(
     ell_delays: np.ndarray | None,
     constant_delay: int,
     n_node_shards: int,
+    uniform_placeholder: bool = True,
 ):
     """ELL arrays padded so rows divide evenly across node shards. Padding
-    rows have empty masks: they never receive or send."""
+    rows have empty masks: they never receive or send.
+
+    ``uniform_placeholder`` stages a one-column placeholder delay array
+    when every edge shares one delay (the flood engine's fast path never
+    reads per-edge delays); the partnered protocols index delays per
+    random pick, so they pass False to keep the real array."""
     ell_idx, ell_mask = graph.ell()
     if ell_delays is None:
         ell_delays = np.full(ell_idx.shape, constant_delay, dtype=np.int32)
@@ -61,7 +67,7 @@ def _padded_device_graph(
     uniform = detect_uniform_delay(ell_delays, ell_mask)
     ell_mask = pad_to_multiple(ell_mask, n_node_shards)
     ring = (int(ell_delays.max()) if ell_delays.size else 1) + 1
-    if uniform is not None:
+    if uniform is not None and uniform_placeholder:
         # The uniform fast path never reads per-edge delays: stage one
         # placeholder row per shard instead of (N, dmax) of dead HBM.
         ell_delays = np.ones((ell_idx.shape[0], 1), dtype=np.int32)
@@ -69,6 +75,20 @@ def _padded_device_graph(
         ell_delays = pad_to_multiple(ell_delays, n_node_shards, fill=1)
     degree = pad_to_multiple(graph.degree.astype(np.int32), n_node_shards)
     return ell_idx, ell_delays, ell_mask, degree, ring, uniform
+
+
+def _padded_churn(churn, n_padded: int, n_node_shards: int):
+    """Churn intervals padded with their node rows ((n_padded, 1) zeros —
+    vacuously up — when churn is off)."""
+    if churn is not None:
+        return (
+            pad_to_multiple(churn.down_start, n_node_shards),
+            pad_to_multiple(churn.down_end, n_node_shards),
+        )
+    return (
+        np.zeros((n_padded, 1), dtype=np.int32),
+        np.zeros((n_padded, 1), dtype=np.int32),
+    )
 
 
 def _stage_sharded_inputs(
@@ -91,12 +111,7 @@ def _stage_sharded_inputs(
     n_padded = ell_idx.shape[0]
     if block is None:
         block = tuned_degree_block(ell_idx.shape[1], mesh.devices.flat)
-    if churn is not None:
-        churn_start = pad_to_multiple(churn.down_start, n_node_shards)
-        churn_end = pad_to_multiple(churn.down_end, n_node_shards)
-    else:
-        churn_start = np.zeros((n_padded, 1), dtype=np.int32)
-        churn_end = np.zeros((n_padded, 1), dtype=np.int32)
+    churn_start, churn_end = _padded_churn(churn, n_padded, n_node_shards)
     return (
         ell_idx, ell_delay, ell_mask, degree, ring, uniform, n_padded,
         block, churn_start, churn_end,

@@ -40,7 +40,11 @@ from p2p_gossip_tpu.models.partnersel import pick_index_jnp
 from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
 from p2p_gossip_tpu.ops.segment import scatter_or
-from p2p_gossip_tpu.parallel.mesh import NODES_AXIS, SHARES_AXIS, pad_to_multiple
+from p2p_gossip_tpu.parallel.engine_sharded import (
+    _padded_churn,
+    _padded_device_graph,
+)
+from p2p_gossip_tpu.parallel.mesh import NODES_AXIS, SHARES_AXIS
 from p2p_gossip_tpu.utils.stats import NodeStats
 
 
@@ -64,6 +68,7 @@ def build_partnered_runner(
     horizon: int,
     fanout: int = 1,
     loss: tuple | None = None,
+    record_coverage: bool = False,
 ):
     """Compile the per-pass runner for a random-partner protocol over the
     mesh. Memoized on mesh/shapes like engine_sharded.build_sharded_runner.
@@ -98,10 +103,15 @@ def build_partnered_runner(
             jnp.zeros((n_loc,), dtype=jnp.int32),                 # received
             jnp.zeros((n_loc,), dtype=jnp.uint32),                # sent lo
             jnp.zeros((n_loc,), dtype=jnp.uint32),                # sent hi
+            jnp.zeros(
+                (horizon if record_coverage else 0,
+                 chunk_size if record_coverage else 0),
+                dtype=jnp.int32,
+            ),                                                    # coverage
         )
 
         def body(t, state):
-            seen, hist, received, sent_lo, sent_hi = state
+            seen, hist, received, sent_lo, sent_hi, cov_hist = state
             t = jnp.int32(t)
             if protocol == "pushpull":
                 kidx = pick_index_jnp(node_ids, t, 0, degree, seed)
@@ -189,14 +199,21 @@ def build_partnered_runner(
                 exchange = newly | gen_bits           # hist holds frontier
             full = lax.all_gather(exchange, NODES_AXIS, axis=0, tiled=True)
             hist = hist.at[jnp.mod(t, ring_size)].set(full)
-            return (seen, hist, received, sent_lo, sent_hi)
+            if record_coverage:
+                cov = lax.psum(
+                    bitmask.coverage_per_slot(seen, chunk_size), NODES_AXIS
+                )
+                cov_hist = lax.dynamic_update_slice(
+                    cov_hist, cov[None], (t, 0)
+                )
+            return (seen, hist, received, sent_lo, sent_hi, cov_hist)
 
-        seen, _, received, sent_lo, sent_hi = lax.fori_loop(
+        seen, _, received, sent_lo, sent_hi, cov_hist = lax.fori_loop(
             0, horizon, body, state
         )
         # Stack per share-shard (host folds in int64; psum of u32 halves
         # would drop carries).
-        return received[None], sent_lo[None], sent_hi[None]
+        return received[None], sent_lo[None], sent_hi[None], cov_hist[None]
 
     mapped = shard_map(
         pass_fn,
@@ -215,6 +232,7 @@ def build_partnered_runner(
             P(SHARES_AXIS, NODES_AXIS),
             P(SHARES_AXIS, NODES_AXIS),
             P(SHARES_AXIS, NODES_AXIS),
+            P(SHARES_AXIS, None, None),  # coverage (psum'ed over nodes)
         ),
         check_vma=False,
     )
@@ -234,13 +252,18 @@ def run_sharded_partnered_sim(
     seed: int = 0,
     churn=None,
     loss=None,
-) -> NodeStats:
+    record_coverage: bool = False,
+):
     """Drop-in counterpart of run_pushpull_sim / run_pushk_sim on a device
     mesh: identical per-node counters for any mesh shape (the counter-based
     partner hash keys on global node ids, so shard boundaries change
     nothing), including under churn and link loss.
 
-    ``chunk_size`` is per share-shard, as in run_sharded_sim.
+    ``chunk_size`` is per share-shard, as in run_sharded_sim. With
+    ``record_coverage`` also returns the (horizon, num_shares) per-tick
+    node-coverage history (psum'ed over node shards, identical values to
+    the single-device engines); returns stats alone otherwise, matching
+    run_sharded_sim.
     """
     if protocol not in ("pushpull", "pushk"):
         raise ValueError(f"unknown protocol {protocol!r}")
@@ -248,33 +271,30 @@ def run_sharded_partnered_sim(
     chunk_size = min(chunk_size, max(MIN_CHUNK_SHARES, schedule.num_shares))
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
 
-    ell_idx, ell_mask = graph.ell()
-    if ell_delays is None:
-        ell_delays = np.full(ell_idx.shape, constant_delay, dtype=np.int32)
-    ring = (int(ell_delays.max()) if ell_delays.size else 1) + 1
-    ell_idx = pad_to_multiple(ell_idx, n_node_shards)
-    ell_delays = pad_to_multiple(ell_delays, n_node_shards, fill=1)
-    degree = pad_to_multiple(graph.degree.astype(np.int32), n_node_shards)
+    # Shared staging with the flood engine; partner picks index per-edge
+    # delays, so the uniform-delay placeholder is disabled.
+    ell_idx, ell_delays, _, degree, ring, _ = _padded_device_graph(
+        graph, ell_delays, constant_delay, n_node_shards,
+        uniform_placeholder=False,
+    )
     n_padded = ell_idx.shape[0]
-    if churn is not None:
-        churn_start = pad_to_multiple(churn.down_start, n_node_shards)
-        churn_end = pad_to_multiple(churn.down_end, n_node_shards)
-    else:
-        churn_start = np.zeros((n_padded, 1), dtype=np.int32)
-        churn_end = np.zeros((n_padded, 1), dtype=np.int32)
+    churn_start, churn_end = _padded_churn(churn, n_padded, n_node_shards)
 
     runner, pass_size = build_partnered_runner(
         mesh, protocol, n_padded, ring, chunk_size, horizon_ticks,
         fanout if protocol == "pushk" else 1,
         loss.static_cfg if loss is not None else None,
+        record_coverage,
     )
     seed_arr = np.uint32(seed & 0xFFFFFFFF)
+    n_share_shards = mesh.shape[SHARES_AXIS]
 
     received = np.zeros(n_padded, dtype=np.int64)
     sent = np.zeros(n_padded, dtype=np.int64)
+    cov_chunks = []
     for chunk in schedule.chunk(pass_size) or [schedule]:
         origins, gen_ticks = chunk.padded(pass_size, horizon_ticks)
-        r, s_lo, s_hi = runner(
+        r, s_lo, s_hi, cov = runner(
             ell_idx, ell_delays, degree, churn_start, churn_end,
             origins, gen_ticks, seed_arr,
         )
@@ -282,11 +302,22 @@ def run_sharded_partnered_sim(
         sent += bitmask.combine_u64(
             jnp.asarray(s_lo), jnp.asarray(s_hi)
         ).reshape(-1, n_padded).sum(axis=0)
+        if record_coverage:
+            # Reassemble global slot order: shard k's local slots are the
+            # pass's global slots [k*chunk_size, (k+1)*chunk_size).
+            cov = np.asarray(cov)  # (n_share_shards, horizon, chunk_size)
+            parts = []
+            for k in range(n_share_shards):
+                live = min(
+                    max(chunk.num_shares - k * chunk_size, 0), chunk_size
+                )
+                parts.append(cov[k, :, :live])
+            cov_chunks.append(np.concatenate(parts, axis=1))
 
     received = received[: graph.n]
     sent = sent[: graph.n]
     generated = effective_generated(schedule, horizon_ticks, churn)
-    return NodeStats(
+    stats = NodeStats(
         generated=generated,
         received=received,
         forwarded=received.copy(),
@@ -294,3 +325,6 @@ def run_sharded_partnered_sim(
         processed=generated + received,
         degree=graph.degree.astype(np.int64),
     )
+    if record_coverage:
+        return stats, np.concatenate(cov_chunks, axis=1)
+    return stats

@@ -189,3 +189,27 @@ def test_isolated_node_exchanges_nothing_on_every_engine():
         seed=seed,
     )
     assert sharded_pk.equal_counts(single_pk)
+
+
+def test_sharded_partnered_coverage_matches_single_device():
+    g = pg.erdos_renyi(40, 0.15, seed=8)
+    sched = Schedule(
+        g.n,
+        np.arange(90, dtype=np.int32) % g.n,
+        (np.arange(90, dtype=np.int32) % 5).astype(np.int32),
+    )
+    mesh = make_mesh(4, 2)
+    for protocol, single in (
+        ("pushpull", run_pushpull_sim),
+        ("pushk", run_pushk_sim),
+    ):
+        kw = dict(fanout=2) if protocol == "pushk" else {}
+        want, cov_single = single(
+            g, sched, 16, seed=9, chunk_size=32, record_coverage=True, **kw
+        )
+        got, cov_mesh = run_sharded_partnered_sim(
+            g, sched, 16, mesh, protocol=protocol, seed=9, chunk_size=32,
+            record_coverage=True, **kw,
+        )
+        assert got.equal_counts(want), protocol
+        assert np.array_equal(cov_single, cov_mesh), protocol
