@@ -52,6 +52,7 @@ def _padded_device_graph(
     constant_delay: int,
     n_node_shards: int,
     uniform_placeholder: bool = True,
+    with_mask: bool = True,
 ):
     """ELL arrays padded so rows divide evenly across node shards. Padding
     rows have empty masks: they never receive or send.
@@ -59,15 +60,22 @@ def _padded_device_graph(
     ``uniform_placeholder`` stages a one-column placeholder delay array
     when every edge shares one delay (the flood engine's fast path never
     reads per-edge delays); the partnered protocols index delays per
-    random pick, so they pass False to keep the real array."""
+    random pick, so they pass False to keep the real array — and also
+    ``with_mask=False``, since picks always land on valid ELL entries:
+    both the uniform-delay scan and the (N, dmax) mask copy are skipped
+    (the mask slot returns None)."""
     ell_idx, ell_mask = graph.ell()
     if ell_delays is None:
         ell_delays = np.full(ell_idx.shape, constant_delay, dtype=np.int32)
     ell_idx = pad_to_multiple(ell_idx, n_node_shards)
-    uniform = detect_uniform_delay(ell_delays, ell_mask)
-    ell_mask = pad_to_multiple(ell_mask, n_node_shards)
+    uniform = (
+        detect_uniform_delay(ell_delays, ell_mask)
+        if uniform_placeholder
+        else None
+    )
+    ell_mask = pad_to_multiple(ell_mask, n_node_shards) if with_mask else None
     ring = (int(ell_delays.max()) if ell_delays.size else 1) + 1
-    if uniform is not None and uniform_placeholder:
+    if uniform is not None:
         # The uniform fast path never reads per-edge delays: stage one
         # placeholder row per shard instead of (N, dmax) of dead HBM.
         ell_delays = np.ones((ell_idx.shape[0], 1), dtype=np.int32)

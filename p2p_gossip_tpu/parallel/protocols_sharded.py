@@ -78,6 +78,8 @@ def build_partnered_runner(
     int64 (a psum of the raw u64 halves would drop carries)."""
     if protocol not in ("pushpull", "pushk"):
         raise ValueError(f"unknown protocol {protocol!r}")
+    if fanout < 1:
+        raise ValueError(f"fanout must be >= 1, got {fanout}")
     n_share_shards = mesh.shape[SHARES_AXIS]
     n_node_shards = mesh.shape[NODES_AXIS]
     n_loc = n_padded // n_node_shards
@@ -272,10 +274,10 @@ def run_sharded_partnered_sim(
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
 
     # Shared staging with the flood engine; partner picks index per-edge
-    # delays, so the uniform-delay placeholder is disabled.
+    # delays (no placeholder) and always land on valid entries (no mask).
     ell_idx, ell_delays, _, degree, ring, _ = _padded_device_graph(
         graph, ell_delays, constant_delay, n_node_shards,
-        uniform_placeholder=False,
+        uniform_placeholder=False, with_mask=False,
     )
     n_padded = ell_idx.shape[0]
     churn_start, churn_end = _padded_churn(churn, n_padded, n_node_shards)

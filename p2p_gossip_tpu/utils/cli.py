@@ -181,17 +181,18 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
     shares flooded from random origins at t=0, per-share
     time-to-``coverageFraction`` reported in ticks and seconds. Runs on
     the single-device sync engine or, with --backend sharded, over the
-    device mesh (identical coverage values)."""
+    device mesh (identical coverage values). With --protocol pushpull or
+    pushk the same experiment runs under that protocol instead of
+    flooding — the direct CLI comparison of the protocols'
+    coverage-time/redundancy trade-off."""
     from p2p_gossip_tpu.engine.sync import run_flood_coverage, time_to_coverage
 
     tick_dt = args.Latency / 1000.0
     rng = np.random.default_rng(args.seed)
     origins = rng.integers(0, g.n, args.floodCoverage).astype(np.int32)
     t0 = time.perf_counter()
+    mesh = None
     if args.backend == "sharded":
-        from p2p_gossip_tpu.parallel.engine_sharded import (
-            run_sharded_flood_coverage,
-        )
         from p2p_gossip_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh(args.meshNodes or None, args.meshShares)
@@ -199,6 +200,39 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
             f"Mesh: {mesh.shape['shares']} share-shards x "
             f"{mesh.shape['nodes']} node-shards"
         )
+    if args.protocol in ("pushpull", "pushk"):
+        from p2p_gossip_tpu.models.generation import Schedule
+
+        sched = Schedule(g.n, origins, np.zeros(len(origins), dtype=np.int32))
+        kw = dict(fanout=args.fanout) if args.protocol == "pushk" else {}
+        if mesh is not None:
+            from p2p_gossip_tpu.parallel.protocols_sharded import (
+                run_sharded_partnered_sim,
+            )
+
+            stats, coverage = run_sharded_partnered_sim(
+                g, sched, horizon, mesh, protocol=args.protocol,
+                ell_delays=delays, seed=args.seed,
+                chunk_size=args.chunkSize, churn=churn, loss=loss,
+                record_coverage=True, **kw,
+            )
+        else:
+            from p2p_gossip_tpu.models.protocols import (
+                run_pushk_sim,
+                run_pushpull_sim,
+            )
+
+            run = run_pushpull_sim if args.protocol == "pushpull" else run_pushk_sim
+            stats, coverage = run(
+                g, sched, horizon, ell_delays=delays, seed=args.seed,
+                chunk_size=args.chunkSize, churn=churn, loss=loss,
+                record_coverage=True, **kw,
+            )
+    elif mesh is not None:
+        from p2p_gossip_tpu.parallel.engine_sharded import (
+            run_sharded_flood_coverage,
+        )
+
         stats, coverage = run_sharded_flood_coverage(
             g, origins, horizon, mesh, ell_delays=delays,
             chunk_size=args.chunkSize, block=args.degreeBlock or None,
@@ -213,7 +247,8 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
     ttc = time_to_coverage(coverage, g.n, args.coverageFraction)
     reached = ttc >= 0
     print(
-        f"=== Flood Coverage ({args.floodCoverage} shares, target "
+        f"=== {'Flood' if args.protocol == 'push' else args.protocol} "
+        f"Coverage ({args.floodCoverage} shares, target "
         f"{args.coverageFraction:.0%} of {g.n} nodes) ==="
     )
     if reached.any():
@@ -419,6 +454,12 @@ def run(argv=None) -> int:
         else []
     )
 
+    if args.protocol == "pushk" and args.fanout < 1:
+        # Validated before the --floodCoverage early return: that path
+        # runs pushk too.
+        print("error: --fanout must be >= 1", file=sys.stderr)
+        return 2
+
     if args.floodCoverage:
         if args.floodCoverage < 0:
             print(
@@ -427,10 +468,9 @@ def run(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
-        if args.backend not in ("tpu", "sharded") or args.protocol != "push":
+        if args.backend not in ("tpu", "sharded"):
             print(
-                "error: --floodCoverage requires --backend tpu|sharded "
-                "--protocol push",
+                "error: --floodCoverage requires --backend tpu|sharded",
                 file=sys.stderr,
             )
             return 2
@@ -451,9 +491,6 @@ def run(argv=None) -> int:
             "tpu|sharded",
             file=sys.stderr,
         )
-        return 2
-    if args.protocol == "pushk" and args.fanout < 1:
-        print("error: --fanout must be >= 1", file=sys.stderr)
         return 2
 
 

@@ -136,3 +136,38 @@ def test_graph_builder_flag(capsys):
          "native", "--backend", "event"]
     ) == 2
     assert "no ring builder" in capsys.readouterr().err
+
+
+def test_coverage_experiment_with_partnered_protocols(capsys):
+    """--floodCoverage composes with --protocol pushpull/pushk (single
+    device and sharded), reporting the protocol's coverage-time and
+    redundancy."""
+    from p2p_gossip_tpu.utils.cli import run
+
+    common = [
+        "--numNodes", "60", "--connectionProb", "0.1", "--simTime", "0.3",
+        "--Latency", "5", "--floodCoverage", "4", "--seed", "2",
+    ]
+    rc = run(common + ["--protocol", "pushk", "--fanout", "3"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "pushk Coverage (4 shares" in out
+    assert "Redundancy:" in out
+
+    rc = run(common + ["--protocol", "pushpull", "--backend", "sharded",
+                       "--chunkSize", "32"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "pushpull Coverage (4 shares" in out
+    assert "Shares reaching target: 4/4" in out
+
+
+def test_fanout_validated_on_coverage_path(capsys):
+    from p2p_gossip_tpu.utils.cli import run
+
+    rc = run([
+        "--numNodes", "20", "--floodCoverage", "3", "--protocol", "pushk",
+        "--fanout", "0",
+    ])
+    assert rc == 2
+    assert "--fanout" in capsys.readouterr().err

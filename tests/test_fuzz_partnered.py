@@ -1,0 +1,95 @@
+"""Randomized cross-engine parity for the random-partner protocols: for
+random combinations of topology, delay model, churn, loss, fanout, and
+mesh shape, the single-device engine, the numpy oracle (fed the
+host-replicated seeded picks), and the shard_map mesh engine must produce
+identical per-node counters. The partnered-protocol analogue of
+test_fuzz_parity.py."""
+
+import numpy as np
+import pytest
+
+import p2p_gossip_tpu as pg
+from p2p_gossip_tpu.models.churn import random_churn
+from p2p_gossip_tpu.models.latency import lognormal_delays
+from p2p_gossip_tpu.models.linkloss import LinkLossModel
+from p2p_gossip_tpu.models.protocols import (
+    pushk_oracle,
+    pushpull_oracle,
+    run_pushk_sim,
+    run_pushpull_sim,
+    seeded_partners,
+)
+from p2p_gossip_tpu.parallel.mesh import make_mesh
+from p2p_gossip_tpu.parallel.protocols_sharded import run_sharded_partnered_sim
+
+
+def _random_config(seed: int):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(20, 70))
+    family = rng.choice(["er", "ba", "ring"])
+    if family == "er":
+        g = pg.erdos_renyi(n, float(rng.uniform(0.08, 0.2)), seed=seed)
+    elif family == "ba":
+        g = pg.barabasi_albert(n, m=int(rng.integers(2, 5)), seed=seed)
+    else:
+        g = pg.ring_graph(n)
+    horizon = int(rng.integers(10, 30))
+    n_shares = int(rng.integers(1, 40))
+    sched = pg.Schedule(
+        n,
+        rng.integers(0, n, n_shares).astype(np.int32),
+        rng.integers(0, max(horizon - 2, 1), n_shares).astype(np.int32),
+    )
+    delays = (
+        lognormal_delays(g, 2.0, 0.5, int(rng.integers(3, 6)), seed=seed)
+        if rng.random() < 0.4
+        else None
+    )
+    churn = (
+        random_churn(
+            n, horizon, outage_prob=0.3, mean_down_ticks=8.0,
+            max_outages=2, seed=seed + 1,
+        )
+        if rng.random() < 0.5
+        else None
+    )
+    loss = (
+        LinkLossModel(float(rng.uniform(0.05, 0.5)), seed=seed + 2)
+        if rng.random() < 0.5
+        else None
+    )
+    protocol = "pushpull" if rng.random() < 0.5 else "pushk"
+    fanout = int(rng.integers(1, 5))
+    shares_shards = int(rng.choice([1, 2, 4]))
+    mesh_shape = (shares_shards, 8 // shares_shards)
+    return g, sched, horizon, delays, churn, loss, protocol, fanout, mesh_shape
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_partnered_three_way_parity_random_config(seed):
+    (g, sched, horizon, delays, churn, loss, protocol, fanout,
+     (shares, nodes)) = _random_config(seed)
+    single_fn = run_pushpull_sim if protocol == "pushpull" else run_pushk_sim
+    kw = {} if protocol == "pushpull" else {"fanout": fanout}
+    single, _ = single_fn(
+        g, sched, horizon, ell_delays=delays, seed=seed, chunk_size=32,
+        churn=churn, loss=loss, **kw,
+    )
+    sharded = run_sharded_partnered_sim(
+        g, sched, horizon, make_mesh(nodes, shares), protocol=protocol,
+        fanout=fanout, ell_delays=delays, seed=seed, chunk_size=32,
+        churn=churn, loss=loss,
+    )
+    assert sharded.equal_counts(single), (seed, protocol)
+    # The numpy oracle covers the uniform one-tick-delay case only.
+    if delays is None:
+        oracle_fn = pushpull_oracle if protocol == "pushpull" else pushk_oracle
+        picks = seeded_partners(
+            g, horizon, seed,
+            fanout=None if protocol == "pushpull" else fanout,
+        )
+        want = oracle_fn(g, sched, horizon, picks, churn=churn, loss=loss)
+        assert single.equal_counts(want), (seed, protocol)
+    # Structural invariants shared by the protocol family.
+    assert (single.received == single.forwarded).all()
+    assert (single.processed == single.generated + single.received).all()
