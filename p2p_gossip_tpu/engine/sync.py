@@ -511,13 +511,10 @@ def run_sync_sim(
             checkpoint_every,
         )
 
+    from p2p_gossip_tpu.utils.checkpoint import checkpointed_chunks
+
     chunks = schedule.chunk(chunk_size)
-    done_this_call = 0
-    for ci, chunk in enumerate(chunks):
-        if checkpointer is not None and ci < checkpointer.start_chunk:
-            continue
-        if stop_after_chunks is not None and done_this_call >= stop_after_chunks:
-            break
+    for ci, chunk in checkpointed_chunks(chunks, checkpointer, stop_after_chunks):
         live = chunk.gen_ticks < horizon_ticks
         if live.any():
             origins, gen_ticks = chunk.padded(chunk_size, horizon_ticks)
@@ -540,9 +537,6 @@ def run_sync_sim(
             sent += np.asarray(s, dtype=np.int64)
             if boundaries:
                 snap_received += np.asarray(snaps, dtype=np.int64)
-        done_this_call += 1
-        if checkpointer is not None:
-            checkpointer.maybe_save(done_this_call, ci, len(chunks) - 1)
 
     generated = effective_generated(schedule, horizon_ticks, churn)
     degree = np.asarray(dg.degree, dtype=np.int64)

@@ -171,6 +171,9 @@ def run_pushpull_sim(
     chunk_size: int = 4096,
     churn=None,
     loss=None,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 1,
+    stop_after_chunks: int | None = None,
 ):
     """Push-pull anti-entropy for ``horizon_ticks`` rounds.
 
@@ -191,16 +194,23 @@ def run_pushpull_sim(
 
     Digest traffic is per-round per-node regardless of chunking: chunking
     splits the digest into per-chunk digests, so `sent` stays exact.
+
+    ``checkpoint_path``/``checkpoint_every``/``stop_after_chunks`` give the
+    chunk-boundary checkpoint/resume contract of run_sync_sim (not
+    combinable with ``record_coverage`` — a resumed run would be missing
+    the skipped chunks' coverage history).
     """
     return _run_partnered_sim(
-        _run_pushpull, graph, schedule, horizon_ticks, ell_delays,
-        constant_delay, seed, record_coverage, partners_override,
+        _run_pushpull, ("pushpull",), graph, schedule, horizon_ticks,
+        ell_delays, constant_delay, seed, record_coverage, partners_override,
         device_graph, chunk_size, churn, loss,
+        checkpoint_path, checkpoint_every, stop_after_chunks,
     )
 
 
 def _run_partnered_sim(
     kernel,
+    fingerprint_extra: tuple,
     graph: Graph,
     schedule: Schedule,
     horizon_ticks: int,
@@ -213,12 +223,17 @@ def _run_partnered_sim(
     chunk_size,
     churn,
     loss,
+    checkpoint_path=None,
+    checkpoint_every=1,
+    stop_after_chunks=None,
 ):
     """Shared chunk driver for the random-partner protocols (push-pull,
     fanout push). ``kernel`` is a jitted round loop with `_run_pushpull`'s
     signature returning (seen, received, sent-u64-pair, coverage); partner
     selection inside it must be keyed only by (seed, round) so counters
-    stay exactly additive across share chunks."""
+    stay exactly additive across share chunks. ``fingerprint_extra``
+    (protocol name + protocol-specific statics) keys the checkpoint
+    fingerprint so resumes can't cross protocols."""
     # Partner selection indexes the full-width ELL directly, so bucketed
     # staging (which replaces it with a placeholder) is not usable here.
     dg = device_graph or DeviceGraph.build(
@@ -236,20 +251,55 @@ def _run_partnered_sim(
         if partners_override is not None
         else jnp.zeros((0,), dtype=jnp.int32)
     )
-    seed = jnp.uint32(seed & 0xFFFFFFFF)
+    seed_dev = jnp.uint32(seed & 0xFFFFFFFF)
     churn_dev = churn_to_device(churn)
     loss_cfg = loss.static_cfg if loss is not None else None
 
     received = np.zeros(graph.n, dtype=np.int64)
     sent = np.zeros(graph.n, dtype=np.int64)
+
+    checkpointer = None
+    if checkpoint_path is not None:
+        if record_coverage:
+            raise ValueError(
+                "checkpointing is not combinable with record_coverage (a "
+                "resumed run would be missing the skipped chunks' coverage)"
+            )
+        from p2p_gossip_tpu.engine.sync import _canonical_delays
+        from p2p_gossip_tpu.utils.checkpoint import (
+            ChunkCheckpointer,
+            fingerprint,
+        )
+
+        ckpt_fp = fingerprint(
+            "partnered_sim", *fingerprint_extra, graph.n, graph.edges(),
+            schedule.origins, schedule.gen_ticks, horizon_ticks, chunk_size,
+            _canonical_delays(dg), dg.uniform_delay, dg.ring_size,
+            int(seed) & 0xFFFFFFFF,   # partner picks depend on the seed
+            # The override replaces partner selection entirely, so it is
+            # as run-determining as the seed.
+            partners_override,
+            churn.down_start if churn is not None else None,
+            churn.down_end if churn is not None else None,
+            *([np.asarray(loss_cfg, dtype=np.int64)] if loss_cfg else []),
+        )
+        checkpointer = ChunkCheckpointer(
+            checkpoint_path, ckpt_fp,
+            {"received": received, "sent": sent},
+            checkpoint_every,
+        )
+
+    from p2p_gossip_tpu.utils.checkpoint import checkpointed_chunks
+
     cov_chunks = []
-    for chunk in schedule.chunk(chunk_size) or [schedule]:
+    chunks = schedule.chunk(chunk_size) or [schedule]
+    for ci, chunk in checkpointed_chunks(chunks, checkpointer, stop_after_chunks):
         origins, gen_ticks = chunk.padded(chunk_size, horizon_ticks)
         _, r, (s_lo, s_hi), coverage = kernel(
             dg,
             jnp.asarray(origins),
             jnp.asarray(gen_ticks),
-            seed,
+            seed_dev,
             override,
             churn_dev,
             chunk_size=chunk_size,
@@ -498,6 +548,9 @@ def run_pushk_sim(
     chunk_size: int = 4096,
     churn=None,
     loss=None,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 1,
+    stop_after_chunks: int | None = None,
 ):
     """Fanout-limited push gossip ("rumor mongering") for ``horizon_ticks``
     rounds.
@@ -525,9 +578,10 @@ def run_pushk_sim(
     if fanout < 1:
         raise ValueError(f"fanout must be >= 1, got {fanout}")
     return _run_partnered_sim(
-        functools.partial(_run_pushk, fanout=fanout), graph, schedule,
-        horizon_ticks, ell_delays, constant_delay, seed, record_coverage,
-        partners_override, device_graph, chunk_size, churn, loss,
+        functools.partial(_run_pushk, fanout=fanout), ("pushk", fanout),
+        graph, schedule, horizon_ticks, ell_delays, constant_delay, seed,
+        record_coverage, partners_override, device_graph, chunk_size, churn,
+        loss, checkpoint_path, checkpoint_every, stop_after_chunks,
     )
 
 

@@ -385,13 +385,10 @@ def run_sharded_sim(
             checkpoint_every,
         )
 
+    from p2p_gossip_tpu.utils.checkpoint import checkpointed_chunks
+
     chunks = schedule.chunk(pass_size)
-    done_this_call = 0
-    for ci, chunk in enumerate(chunks):
-        if checkpointer is not None and ci < checkpointer.start_chunk:
-            continue
-        if stop_after_chunks is not None and done_this_call >= stop_after_chunks:
-            break
+    for ci, chunk in checkpointed_chunks(chunks, checkpointer, stop_after_chunks):
         live = chunk.gen_ticks < horizon_ticks
         if live.any():
             origins, gen_ticks = chunk.padded(pass_size, horizon_ticks)
@@ -405,9 +402,6 @@ def run_sharded_sim(
             sent += np.asarray(s, dtype=np.int64)
             if boundaries:
                 snap_received += np.asarray(sn, dtype=np.int64)
-        done_this_call += 1
-        if checkpointer is not None:
-            checkpointer.maybe_save(done_this_call, ci, len(chunks) - 1)
 
     received = received[: graph.n]
     sent = sent[: graph.n]

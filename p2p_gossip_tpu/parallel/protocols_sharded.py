@@ -255,6 +255,9 @@ def run_sharded_partnered_sim(
     churn=None,
     loss=None,
     record_coverage: bool = False,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 1,
+    stop_after_chunks: int | None = None,
 ):
     """Drop-in counterpart of run_pushpull_sim / run_pushk_sim on a device
     mesh: identical per-node counters for any mesh shape (the counter-based
@@ -265,7 +268,11 @@ def run_sharded_partnered_sim(
     ``record_coverage`` also returns the (horizon, num_shares) per-tick
     node-coverage history (psum'ed over node shards, identical values to
     the single-device engines); returns stats alone otherwise, matching
-    run_sharded_sim.
+    run_sharded_sim. ``checkpoint_path``/``checkpoint_every``/
+    ``stop_after_chunks`` give run_sharded_sim's pass-boundary
+    checkpoint/resume contract (mesh shape is fingerprinted — a resume on
+    a different mesh starts fresh; not combinable with
+    ``record_coverage``).
     """
     if protocol not in ("pushpull", "pushk"):
         raise ValueError(f"unknown protocol {protocol!r}")
@@ -293,8 +300,43 @@ def run_sharded_partnered_sim(
 
     received = np.zeros(n_padded, dtype=np.int64)
     sent = np.zeros(n_padded, dtype=np.int64)
+
+    checkpointer = None
+    if checkpoint_path is not None:
+        if record_coverage:
+            raise ValueError(
+                "checkpointing is not combinable with record_coverage (a "
+                "resumed run would be missing the skipped chunks' coverage)"
+            )
+        from p2p_gossip_tpu.utils.checkpoint import (
+            ChunkCheckpointer,
+            fingerprint,
+        )
+
+        ckpt_fp = fingerprint(
+            "sharded_partnered_sim", protocol,
+            fanout if protocol == "pushk" else 1,
+            graph.n, graph.edges(), schedule.origins, schedule.gen_ticks,
+            horizon_ticks, chunk_size,
+            mesh.shape[SHARES_AXIS], mesh.shape[NODES_AXIS],
+            ell_delays, int(seed) & 0xFFFFFFFF,
+            churn.down_start if churn is not None else None,
+            churn.down_end if churn is not None else None,
+            np.asarray(loss.static_cfg, dtype=np.int64)
+            if loss is not None
+            else None,
+        )
+        checkpointer = ChunkCheckpointer(
+            checkpoint_path, ckpt_fp,
+            {"received": received, "sent": sent},
+            checkpoint_every,
+        )
+
+    from p2p_gossip_tpu.utils.checkpoint import checkpointed_chunks
+
     cov_chunks = []
-    for chunk in schedule.chunk(pass_size) or [schedule]:
+    chunks = schedule.chunk(pass_size) or [schedule]
+    for ci, chunk in checkpointed_chunks(chunks, checkpointer, stop_after_chunks):
         origins, gen_ticks = chunk.padded(pass_size, horizon_ticks)
         r, s_lo, s_hi, cov = runner(
             ell_idx, ell_delays, degree, churn_start, churn_end,

@@ -141,3 +141,22 @@ class ChunkCheckpointer:
         chunks this call, and always after the final chunk."""
         if done_this_call % self.checkpoint_every == 0 or ci == last_ci:
             self.save(ci + 1)
+
+
+def checkpointed_chunks(chunks, checkpointer, stop_after_chunks=None):
+    """The chunk-loop frame shared by every checkpointable engine: yields
+    (ci, chunk) for exactly the chunks this call should run — skipping the
+    chunks a resume already completed, stopping early after
+    ``stop_after_chunks``, and saving after each yielded chunk returns.
+    ``checkpointer`` may be None (no skip, no save)."""
+    done = 0
+    last = len(chunks) - 1
+    for ci, chunk in enumerate(chunks):
+        if checkpointer is not None and ci < checkpointer.start_chunk:
+            continue
+        if stop_after_chunks is not None and done >= stop_after_chunks:
+            break
+        yield ci, chunk
+        done += 1
+        if checkpointer is not None:
+            checkpointer.maybe_save(done, ci, last)

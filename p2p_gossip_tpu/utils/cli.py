@@ -494,12 +494,9 @@ def run(argv=None) -> int:
         return 2
 
 
-    if args.checkpoint and (
-        args.backend not in ("tpu", "sharded") or args.protocol != "push"
-    ):
+    if args.checkpoint and args.backend not in ("tpu", "sharded"):
         print(
-            "error: --checkpoint requires --backend tpu|sharded "
-            "--protocol push",
+            "error: --checkpoint requires --backend tpu|sharded",
             file=sys.stderr,
         )
         return 2
@@ -523,6 +520,8 @@ def run(argv=None) -> int:
             g, sched, horizon, mesh, protocol=args.protocol,
             fanout=args.fanout, ell_delays=delays, seed=args.seed,
             chunk_size=args.chunkSize, churn=churn, loss=loss,
+            checkpoint_path=args.checkpoint or None,
+            checkpoint_every=args.checkpointEvery,
         )
     elif args.protocol == "pushpull":
         from p2p_gossip_tpu.models.protocols import run_pushpull_sim
@@ -530,6 +529,8 @@ def run(argv=None) -> int:
         stats, _ = run_pushpull_sim(
             g, sched, horizon, ell_delays=delays, seed=args.seed,
             chunk_size=args.chunkSize, churn=churn, loss=loss,
+            checkpoint_path=args.checkpoint or None,
+            checkpoint_every=args.checkpointEvery,
         )
     elif args.protocol == "pushk":
         from p2p_gossip_tpu.models.protocols import run_pushk_sim
@@ -537,6 +538,8 @@ def run(argv=None) -> int:
         stats, _ = run_pushk_sim(
             g, sched, horizon, fanout=args.fanout, ell_delays=delays,
             seed=args.seed, chunk_size=args.chunkSize, churn=churn, loss=loss,
+            checkpoint_path=args.checkpoint or None,
+            checkpoint_every=args.checkpointEvery,
         )
     elif args.backend == "tpu":
         from p2p_gossip_tpu.engine.sync import run_sync_sim

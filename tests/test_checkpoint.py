@@ -172,3 +172,68 @@ def test_sharded_interrupted_run_resumes(tmp_path):
     )
     for f in ("received", "sent"):
         assert np.array_equal(getattr(full, f), getattr(other, f)), f
+
+
+def test_partnered_interrupted_run_resumes(tmp_path):
+    """Checkpoint/resume on the random-partner protocols: interrupt after
+    one chunk, resume, counters equal the uninterrupted run — for both
+    protocols and on the mesh engine."""
+    import p2p_gossip_tpu as pg
+    from p2p_gossip_tpu.models.generation import Schedule
+    from p2p_gossip_tpu.models.protocols import run_pushk_sim, run_pushpull_sim
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+    from p2p_gossip_tpu.parallel.protocols_sharded import (
+        run_sharded_partnered_sim,
+    )
+
+    g = pg.erdos_renyi(40, 0.15, seed=2)
+    sched = Schedule(
+        g.n,
+        np.arange(120, dtype=np.int32) % g.n,
+        (np.arange(120, dtype=np.int32) % 5).astype(np.int32),
+    )
+    horizon = 15
+    for name, run in (("pushpull", run_pushpull_sim), ("pushk", run_pushk_sim)):
+        kw = dict(fanout=2) if name == "pushk" else {}
+        path = str(tmp_path / f"{name}.npz")
+        want, _ = run(g, sched, horizon, seed=4, chunk_size=32, **kw)
+        partial, _ = run(
+            g, sched, horizon, seed=4, chunk_size=32,
+            checkpoint_path=path, stop_after_chunks=1, **kw,
+        )
+        assert not partial.equal_counts(want), name  # genuinely interrupted
+        resumed, _ = run(
+            g, sched, horizon, seed=4, chunk_size=32,
+            checkpoint_path=path, **kw,
+        )
+        assert resumed.equal_counts(want), name
+
+    mesh = make_mesh(4, 2)
+    path = str(tmp_path / "sharded.npz")
+    want = run_sharded_partnered_sim(
+        g, sched, horizon, mesh, protocol="pushpull", seed=4, chunk_size=32
+    )
+    partial = run_sharded_partnered_sim(
+        g, sched, horizon, mesh, protocol="pushpull", seed=4, chunk_size=32,
+        checkpoint_path=path, stop_after_chunks=1,
+    )
+    assert not partial.equal_counts(want)  # genuinely interrupted
+    resumed = run_sharded_partnered_sim(
+        g, sched, horizon, mesh, protocol="pushpull", seed=4, chunk_size=32,
+        checkpoint_path=path,
+    )
+    assert resumed.equal_counts(want)
+
+
+def test_partnered_checkpoint_rejects_coverage(tmp_path):
+    import p2p_gossip_tpu as pg
+    from p2p_gossip_tpu.models.generation import single_share_schedule
+    from p2p_gossip_tpu.models.protocols import run_pushpull_sim
+
+    g = pg.erdos_renyi(20, 0.3, seed=0)
+    sched = single_share_schedule(g.n, origin=0)
+    with pytest.raises(ValueError):
+        run_pushpull_sim(
+            g, sched, 5, checkpoint_path=str(tmp_path / "c.npz"),
+            record_coverage=True,
+        )
