@@ -71,6 +71,25 @@ struct SeenSet {
   }
 };
 
+// Counter-based random partner pick — the exact uint32 spec of
+// models/partnersel.py (same splitmix32 finalizer as the loss coin, with
+// pick-slot keying): every engine in any language selects the same
+// neighbor-slot index for (node, tick, pick, seed), which is what makes
+// seeded cross-language counter parity possible for the random-partner
+// protocols.
+inline int64_t partner_pick(int64_t node, int64_t t, int64_t j, int64_t deg,
+                            uint32_t seed) {
+  uint32_t h = seed ^ (static_cast<uint32_t>(node) * 0x9E3779B1u) ^
+               (static_cast<uint32_t>(t) * 0x85EBCA77u) ^
+               (static_cast<uint32_t>(j) * 0xC2B2AE3Du);
+  h ^= h >> 16;
+  h *= 0x7FEB352Du;
+  h ^= h >> 15;
+  h *= 0x846CA68Bu;
+  h ^= h >> 16;
+  return h % static_cast<uint32_t>(deg > 0 ? deg : 1);
+}
+
 }  // namespace
 
 extern "C" {
@@ -78,7 +97,7 @@ extern "C" {
 // Bump whenever any exported signature changes. runtime/native.py refuses a
 // library whose version doesn't match (a stale .so bound with the wrong
 // argument layout would corrupt memory) and falls back to the Python engine.
-int64_t gossip_abi_version() { return 3; }
+int64_t gossip_abi_version() { return 4; }
 
 // Runs the event-driven simulation. Returns the number of events processed
 // (heap pops), the metric NS-3-style engines are measured by. Snapshot
@@ -170,6 +189,127 @@ int64_t gossip_run_event_sim(
   }
   take_snapshots(horizon);
   return events;
+}
+
+// Round-based random-partner protocols (push-pull anti-entropy and
+// fanout-limited push) — the C++ leg of the cross-engine parity contract
+// with models/protocols.py (single-device jnp), the numpy oracles, and the
+// shard_map mesh engine. Same semantics, tick for tick:
+//   * each round every node with degree > 0 makes its counter-hash partner
+//     pick(s); an exchange with a down endpoint never happens; loss drops
+//     each direction in flight (sender still counts);
+//   * push-pull (protocol 0): the delay-line ring holds past SEEN states;
+//     pull ORs the partner's state as of `delay` rounds ago, push
+//     scatter-ORs mine into the partner; one digest send per attempted
+//     round;
+//   * fanout push (protocol 1): the ring holds past FRONTIERS (newly|gen);
+//     each of `fanout` picks pushes my frontier as of that edge's delay;
+//     one send per attempted pick, costed at the pushed frontier popcount.
+// Returns the number of rounds executed (== horizon), or -1 on bad args.
+int64_t gossip_run_partnered_sim(
+    int64_t n, const int64_t* indptr, const int32_t* indices,
+    const int32_t* csr_delays, int64_t num_shares, const int32_t* origins,
+    const int32_t* gen_ticks, int64_t horizon,
+    int64_t protocol,  // 0 = pushpull, 1 = pushk
+    int64_t fanout, int64_t pick_seed,
+    int64_t churn_k, const int32_t* churn_start, const int32_t* churn_end,
+    int64_t loss_threshold, int64_t loss_seed,
+    int64_t* out_received, int64_t* out_sent) {
+  if (protocol < 0 || protocol > 1 || (protocol == 1 && fanout < 1)) return -1;
+  std::fill(out_received, out_received + n, 0);
+  std::fill(out_sent, out_sent + n, 0);
+
+  const int64_t words = (num_shares + 63) / 64;
+  int64_t max_delay = 1;
+  for (int64_t e = 0; e < indptr[n]; ++e) {
+    max_delay = std::max<int64_t>(max_delay, csr_delays[e]);
+  }
+  const int64_t ring = max_delay + 1;
+  std::vector<uint64_t> seen(static_cast<size_t>(n) * words, 0);
+  std::vector<uint64_t> hist(static_cast<size_t>(ring) * n * words, 0);
+  std::vector<uint64_t> incoming(static_cast<size_t>(n) * words, 0);
+  std::vector<char> up(n, 1);
+
+  const uint32_t pseed = static_cast<uint32_t>(pick_seed);
+  const uint32_t lseed = static_cast<uint32_t>(loss_seed);
+  const int64_t k = protocol == 0 ? 1 : fanout;
+
+  for (int64_t t = 0; t < horizon; ++t) {
+    if (churn_k > 0) {
+      for (int64_t i = 0; i < n; ++i) {
+        up[i] = 1;
+        for (int64_t j = 0; j < churn_k; ++j) {
+          const int64_t s = churn_start[i * churn_k + j];
+          const int64_t e = churn_end[i * churn_k + j];
+          if (s <= t && t < e) {
+            up[i] = 0;
+            break;
+          }
+        }
+      }
+    }
+    std::fill(incoming.begin(), incoming.end(), 0);
+    for (int64_t i = 0; i < n; ++i) {
+      const int64_t deg = indptr[i + 1] - indptr[i];
+      if (deg == 0) continue;
+      for (int64_t j = 0; j < k; ++j) {
+        const int64_t e = indptr[i] + partner_pick(i, t, j, deg, pseed);
+        const int64_t partner = indices[e];
+        const int64_t slot =
+            ((t - csr_delays[e]) % ring + ring) % ring;
+        const uint64_t* mine = &hist[(slot * n + i) * words];
+        const bool attempted = up[i] && up[partner];
+        if (!attempted) continue;
+        int64_t cnt = 0;
+        for (int64_t w = 0; w < words; ++w) {
+          cnt += __builtin_popcountll(mine[w]);
+        }
+        out_sent[i] += cnt;
+        if (!loss_drop(i, partner, t, loss_threshold, lseed)) {
+          uint64_t* dst = &incoming[partner * words];
+          for (int64_t w = 0; w < words; ++w) dst[w] |= mine[w];
+        }
+        if (protocol == 0 &&
+            !loss_drop(partner, i, t, loss_threshold, lseed)) {
+          const uint64_t* remote = &hist[(slot * n + partner) * words];
+          uint64_t* dst = &incoming[i * words];
+          for (int64_t w = 0; w < words; ++w) dst[w] |= remote[w];
+        }
+      }
+    }
+    // newly before gen (a share can't be in flight before it exists, but
+    // the engines compute in this order — keep it identical).
+    uint64_t* front = &hist[(t % ring) * n * words];
+    if (protocol == 1) std::fill(front, front + n * words, 0);
+    for (int64_t i = 0; i < n; ++i) {
+      uint64_t* sn = &seen[i * words];
+      uint64_t* in = &incoming[i * words];
+      uint64_t* fr = &front[i * words];
+      int64_t cnt = 0;
+      for (int64_t w = 0; w < words; ++w) {
+        const uint64_t newly = in[w] & ~sn[w];
+        cnt += __builtin_popcountll(newly);
+        sn[w] |= newly;
+        if (protocol == 1) fr[w] = newly;
+      }
+      out_received[i] += cnt;
+    }
+    for (int64_t s = 0; s < num_shares; ++s) {
+      if (gen_ticks[s] != t) continue;
+      const int64_t o = origins[s];
+      if (!up[o]) continue;
+      seen[o * words + (s >> 6)] |= 1ull << (s & 63);
+      if (protocol == 1) {
+        front[o * words + (s >> 6)] |= 1ull << (s & 63);
+      }
+    }
+    if (protocol == 0) {
+      // The ring holds full seen-states (post-gen, like the engines).
+      std::memcpy(front, seen.data(),
+                  static_cast<size_t>(n) * words * sizeof(uint64_t));
+    }
+  }
+  return horizon;
 }
 
 namespace {

@@ -258,20 +258,15 @@ def _run_partnered_sim(
     received = np.zeros(graph.n, dtype=np.int64)
     sent = np.zeros(graph.n, dtype=np.int64)
 
-    checkpointer = None
-    if checkpoint_path is not None:
-        if record_coverage:
-            raise ValueError(
-                "checkpointing is not combinable with record_coverage (a "
-                "resumed run would be missing the skipped chunks' coverage)"
-            )
-        from p2p_gossip_tpu.engine.sync import _canonical_delays
-        from p2p_gossip_tpu.utils.checkpoint import (
-            ChunkCheckpointer,
-            fingerprint,
-        )
+    from p2p_gossip_tpu.engine.sync import _canonical_delays
+    from p2p_gossip_tpu.utils.checkpoint import (
+        checkpointed_chunks,
+        make_checkpointer,
+    )
 
-        ckpt_fp = fingerprint(
+    checkpointer = make_checkpointer(
+        checkpoint_path, checkpoint_every, record_coverage,
+        (
             "partnered_sim", *fingerprint_extra, graph.n, graph.edges(),
             schedule.origins, schedule.gen_ticks, horizon_ticks, chunk_size,
             _canonical_delays(dg), dg.uniform_delay, dg.ring_size,
@@ -282,14 +277,9 @@ def _run_partnered_sim(
             churn.down_start if churn is not None else None,
             churn.down_end if churn is not None else None,
             *([np.asarray(loss_cfg, dtype=np.int64)] if loss_cfg else []),
-        )
-        checkpointer = ChunkCheckpointer(
-            checkpoint_path, ckpt_fp,
-            {"received": received, "sent": sent},
-            checkpoint_every,
-        )
-
-    from p2p_gossip_tpu.utils.checkpoint import checkpointed_chunks
+        ),
+        {"received": received, "sent": sent},
+    )
 
     cov_chunks = []
     chunks = schedule.chunk(chunk_size) or [schedule]

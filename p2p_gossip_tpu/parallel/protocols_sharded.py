@@ -301,19 +301,14 @@ def run_sharded_partnered_sim(
     received = np.zeros(n_padded, dtype=np.int64)
     sent = np.zeros(n_padded, dtype=np.int64)
 
-    checkpointer = None
-    if checkpoint_path is not None:
-        if record_coverage:
-            raise ValueError(
-                "checkpointing is not combinable with record_coverage (a "
-                "resumed run would be missing the skipped chunks' coverage)"
-            )
-        from p2p_gossip_tpu.utils.checkpoint import (
-            ChunkCheckpointer,
-            fingerprint,
-        )
+    from p2p_gossip_tpu.utils.checkpoint import (
+        checkpointed_chunks,
+        make_checkpointer,
+    )
 
-        ckpt_fp = fingerprint(
+    checkpointer = make_checkpointer(
+        checkpoint_path, checkpoint_every, record_coverage,
+        (
             "sharded_partnered_sim", protocol,
             fanout if protocol == "pushk" else 1,
             graph.n, graph.edges(), schedule.origins, schedule.gen_ticks,
@@ -325,14 +320,9 @@ def run_sharded_partnered_sim(
             np.asarray(loss.static_cfg, dtype=np.int64)
             if loss is not None
             else None,
-        )
-        checkpointer = ChunkCheckpointer(
-            checkpoint_path, ckpt_fp,
-            {"received": received, "sent": sent},
-            checkpoint_every,
-        )
-
-    from p2p_gossip_tpu.utils.checkpoint import checkpointed_chunks
+        ),
+        {"received": received, "sent": sent},
+    )
 
     cov_chunks = []
     chunks = schedule.chunk(pass_size) or [schedule]

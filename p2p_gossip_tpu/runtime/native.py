@@ -31,7 +31,7 @@ _lib_checked = False
 # Must match gossip_abi_version() in native/gossip_native.cc. Binding a stale
 # .so with a different argument layout would scribble over the wrong buffers,
 # so a mismatch is treated as "not built".
-ABI_VERSION = 3
+ABI_VERSION = 4
 
 
 def _try_autobuild() -> None:
@@ -124,6 +124,25 @@ def _configure(lib) -> None:
         ctypes.c_int64,              # num_snapshots
         i64p, i64p, i64p,            # snapshot_ticks, snap_generated, snap_processed
         i64p, i64p, i64p,            # out: generated, received, sent
+    ]
+    lib.gossip_run_partnered_sim.restype = ctypes.c_longlong
+    lib.gossip_run_partnered_sim.argtypes = [
+        ctypes.c_int64,              # n
+        i64p,                        # indptr (n+1)
+        i32p,                        # indices (nnz)
+        i32p,                        # csr_delays (nnz)
+        ctypes.c_int64,              # num_shares
+        i32p,                        # origins
+        i32p,                        # gen_ticks
+        ctypes.c_int64,              # horizon
+        ctypes.c_int64,              # protocol (0 = pushpull, 1 = pushk)
+        ctypes.c_int64,              # fanout
+        ctypes.c_int64,              # pick_seed
+        ctypes.c_int64,              # churn_k
+        i32p, i32p,                  # churn_start, churn_end (n x churn_k)
+        ctypes.c_int64,              # loss_threshold (0 = off)
+        ctypes.c_int64,              # loss_seed
+        i64p, i64p,                  # out: received, sent
     ]
     lib.gossip_build_er.restype = ctypes.c_longlong
     lib.gossip_build_er.argtypes = [
@@ -246,6 +265,101 @@ def run_native_sim(
             for i in range(len(boundaries))
         ]
     return stats
+
+
+def run_native_partnered_sim(
+    graph: Graph,
+    schedule: Schedule,
+    horizon_ticks: int,
+    protocol: str = "pushpull",
+    fanout: int = 2,
+    ell_delays: np.ndarray | None = None,
+    constant_delay: int = 1,
+    seed: int = 0,
+    churn=None,
+    loss=None,
+) -> NodeStats:
+    """Random-partner protocols (push-pull anti-entropy / fanout push) on
+    the C++ engine — counters identical to models.protocols.run_pushpull_sim
+    / run_pushk_sim for the same seed (partner picks and loss coins are the
+    shared counter-hash specs), including under churn and link loss. Falls
+    back to the jnp engines when unbuilt."""
+    if protocol not in ("pushpull", "pushk"):
+        raise ValueError(f"unknown protocol {protocol!r}")
+    lib = load_library()
+    if lib is None:
+        warnings.warn(
+            "native library not built (make -C native); using jnp engine"
+        )
+        from p2p_gossip_tpu.models.protocols import (
+            run_pushk_sim,
+            run_pushpull_sim,
+        )
+
+        if protocol == "pushpull":
+            stats, _ = run_pushpull_sim(
+                graph, schedule, horizon_ticks, ell_delays=ell_delays,
+                constant_delay=constant_delay, seed=seed, churn=churn,
+                loss=loss,
+            )
+        else:
+            stats, _ = run_pushk_sim(
+                graph, schedule, horizon_ticks, fanout=fanout,
+                ell_delays=ell_delays, constant_delay=constant_delay,
+                seed=seed, churn=churn, loss=loss,
+            )
+        return stats
+
+    n = graph.n
+    if ell_delays is not None:
+        rows, pos = graph.csr_rows_pos()
+        csr_delays = np.ascontiguousarray(ell_delays[rows, pos], dtype=np.int32)
+    else:
+        csr_delays = np.full(graph.indices.shape[0], constant_delay, dtype=np.int32)
+    received = np.zeros(n, dtype=np.int64)
+    sent = np.zeros(n, dtype=np.int64)
+    if churn is not None:
+        if churn.n != n:
+            raise ValueError(f"churn model is for {churn.n} nodes, graph has {n}")
+        churn_k = churn.k
+        churn_start = np.ascontiguousarray(churn.down_start, dtype=np.int32)
+        churn_end = np.ascontiguousarray(churn.down_end, dtype=np.int32)
+    else:
+        churn_k = 0
+        churn_start = churn_end = np.zeros(1, dtype=np.int32)
+    rc = lib.gossip_run_partnered_sim(
+        n,
+        np.ascontiguousarray(graph.indptr, dtype=np.int64),
+        np.ascontiguousarray(graph.indices, dtype=np.int32),
+        csr_delays,
+        schedule.num_shares,
+        np.ascontiguousarray(schedule.origins, dtype=np.int32),
+        np.ascontiguousarray(schedule.gen_ticks, dtype=np.int32),
+        horizon_ticks,
+        0 if protocol == "pushpull" else 1,
+        fanout,
+        int(seed) & 0xFFFFFFFF,
+        churn_k,
+        churn_start,
+        churn_end,
+        loss.threshold if loss is not None else 0,
+        loss.seed if loss is not None else 0,
+        received,
+        sent,
+    )
+    if rc < 0:
+        raise ValueError(f"native partnered sim rejected args (rc={rc})")
+    from p2p_gossip_tpu.models.churn import effective_generated
+
+    generated = effective_generated(schedule, horizon_ticks, churn)
+    return NodeStats(
+        generated=generated,
+        received=received,
+        forwarded=received.copy(),
+        sent=sent,
+        processed=generated + received,
+        degree=graph.degree.astype(np.int64),
+    )
 
 
 def _build_native_graph(

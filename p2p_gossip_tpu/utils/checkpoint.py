@@ -160,3 +160,23 @@ def checkpointed_chunks(chunks, checkpointer, stop_after_chunks=None):
         done += 1
         if checkpointer is not None:
             checkpointer.maybe_save(done, ci, last)
+
+
+def make_checkpointer(
+    checkpoint_path, checkpoint_every, record_coverage, fp_parts, arrays
+):
+    """Shared checkpoint setup for the partnered engines: returns None when
+    checkpointing is off, rejects the record_coverage combination (a
+    resumed run would be missing the skipped chunks' coverage history),
+    and otherwise builds a ChunkCheckpointer over ``arrays`` keyed by
+    fingerprint(*fp_parts)."""
+    if checkpoint_path is None:
+        return None
+    if record_coverage:
+        raise ValueError(
+            "checkpointing is not combinable with record_coverage (a "
+            "resumed run would be missing the skipped chunks' coverage)"
+        )
+    return ChunkCheckpointer(
+        checkpoint_path, fingerprint(*fp_parts), arrays, checkpoint_every
+    )

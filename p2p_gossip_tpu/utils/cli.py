@@ -484,16 +484,14 @@ def run(argv=None) -> int:
         return _run_flood_coverage_cli(args, g, horizon, delays, churn, loss)
 
     if args.protocol in ("pushpull", "pushk") and args.backend not in (
-        "tpu", "sharded"
+        "tpu", "sharded", "native"
     ):
         print(
             f"error: --protocol {args.protocol} requires --backend "
-            "tpu|sharded",
+            "tpu|sharded|native",
             file=sys.stderr,
         )
         return 2
-
-
     if args.checkpoint and args.backend not in ("tpu", "sharded"):
         print(
             "error: --checkpoint requires --backend tpu|sharded",
@@ -522,6 +520,13 @@ def run(argv=None) -> int:
             chunk_size=args.chunkSize, churn=churn, loss=loss,
             checkpoint_path=args.checkpoint or None,
             checkpoint_every=args.checkpointEvery,
+        )
+    elif args.protocol in ("pushpull", "pushk") and args.backend == "native":
+        from p2p_gossip_tpu.runtime.native import run_native_partnered_sim
+
+        stats = run_native_partnered_sim(
+            g, sched, horizon, protocol=args.protocol, fanout=args.fanout,
+            ell_delays=delays, seed=args.seed, churn=churn, loss=loss,
         )
     elif args.protocol == "pushpull":
         from p2p_gossip_tpu.models.protocols import run_pushpull_sim

@@ -71,3 +71,60 @@ def test_native_builder_capacity_retry():
     g = _build_native_graph("gossip_build_er", 200, 0.5, seed=5, cap=8)
     g.validate()
     assert abs(g.degree.mean() - 199 * 0.5) < 8.0
+
+
+def test_native_partnered_matches_jnp_engines():
+    """C++ partnered protocols == jnp engines for the same seed: the
+    counter-hash partner picks and loss coins are language-independent
+    specs, so seeded runs agree bit-for-bit — including under per-edge
+    delays, churn, and loss."""
+    from p2p_gossip_tpu.models.churn import ChurnModel
+    from p2p_gossip_tpu.models.generation import Schedule
+    from p2p_gossip_tpu.models.latency import lognormal_delays
+    from p2p_gossip_tpu.models.linkloss import LinkLossModel
+    from p2p_gossip_tpu.models.protocols import run_pushk_sim, run_pushpull_sim
+    from p2p_gossip_tpu.runtime.native import run_native_partnered_sim
+
+    if not native.available():
+        pytest.skip("native library not built")
+    g = pg.erdos_renyi(50, 0.12, seed=4)
+    sched = Schedule(
+        g.n,
+        np.array([0, 9, 21, 33], dtype=np.int32),
+        np.array([0, 1, 4, 6], dtype=np.int32),
+    )
+    horizon, seed = 16, 42
+    delays = lognormal_delays(g, 2.0, 0.5, max_ticks=4, seed=5)
+    down_start = np.zeros((g.n, 1), dtype=np.int32)
+    down_end = np.zeros((g.n, 1), dtype=np.int32)
+    down_start[5, 0], down_end[5, 0] = 3, 12
+    churn = ChurnModel(n=g.n, down_start=down_start, down_end=down_end)
+    loss = LinkLossModel(0.3, seed=9)
+
+    for kw in (
+        dict(),
+        dict(ell_delays=delays),
+        dict(churn=churn),
+        dict(loss=loss),
+        dict(ell_delays=delays, churn=churn, loss=loss),
+    ):
+        want, _ = run_pushpull_sim(g, sched, horizon, seed=seed, **kw)
+        got = run_native_partnered_sim(
+            g, sched, horizon, protocol="pushpull", seed=seed, **kw
+        )
+        assert got.equal_counts(want), ("pushpull", kw.keys())
+        want, _ = run_pushk_sim(g, sched, horizon, fanout=3, seed=seed, **kw)
+        got = run_native_partnered_sim(
+            g, sched, horizon, protocol="pushk", fanout=3, seed=seed, **kw
+        )
+        assert got.equal_counts(want), ("pushk", kw.keys())
+
+
+def test_native_partnered_rejects_bad_args():
+    from p2p_gossip_tpu.models.generation import single_share_schedule
+    from p2p_gossip_tpu.runtime.native import run_native_partnered_sim
+
+    g = pg.erdos_renyi(16, 0.3, seed=0)
+    sched = single_share_schedule(g.n, origin=0)
+    with pytest.raises(ValueError):
+        run_native_partnered_sim(g, sched, 4, protocol="pull")
