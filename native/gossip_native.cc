@@ -36,6 +36,18 @@ inline uint64_t payload(bool gen, int64_t node, int64_t share) {
          static_cast<uint32_t>(share);
 }
 
+// The splitmix32 finalizer shared by the counter-hash specs
+// (models/linkloss.py, models/partnersel.py) — one definition so a typo'd
+// constant can't break bit-parity in just one coin.
+inline uint32_t mix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x7FEB352Du;
+  h ^= h >> 15;
+  h *= 0x846CA68Bu;
+  h ^= h >> 16;
+  return h;
+}
+
 // Per-link loss coin — the exact uint32 spec of models/linkloss.py (xor of
 // keyed multiplies, splitmix32 finalizer). A message crossing directed link
 // (src -> dst) with arrival tick t is dropped iff the coin fires; the same
@@ -44,14 +56,10 @@ inline uint64_t payload(bool gen, int64_t node, int64_t share) {
 inline bool loss_drop(int64_t src, int64_t dst, int64_t t,
                       int64_t threshold, uint32_t seed) {
   if (threshold <= 0) return false;
-  uint32_t h = seed ^ (static_cast<uint32_t>(src) * 0x9E3779B1u) ^
-               (static_cast<uint32_t>(dst) * 0x85EBCA77u) ^
-               (static_cast<uint32_t>(t) * 0xC2B2AE3Du);
-  h ^= h >> 16;
-  h *= 0x7FEB352Du;
-  h ^= h >> 15;
-  h *= 0x846CA68Bu;
-  h ^= h >> 16;
+  const uint32_t h =
+      mix32(seed ^ (static_cast<uint32_t>(src) * 0x9E3779B1u) ^
+            (static_cast<uint32_t>(dst) * 0x85EBCA77u) ^
+            (static_cast<uint32_t>(t) * 0xC2B2AE3Du));
   return h <= static_cast<uint32_t>(threshold - 1);
 }
 
@@ -79,14 +87,10 @@ struct SeenSet {
 // protocols.
 inline int64_t partner_pick(int64_t node, int64_t t, int64_t j, int64_t deg,
                             uint32_t seed) {
-  uint32_t h = seed ^ (static_cast<uint32_t>(node) * 0x9E3779B1u) ^
-               (static_cast<uint32_t>(t) * 0x85EBCA77u) ^
-               (static_cast<uint32_t>(j) * 0xC2B2AE3Du);
-  h ^= h >> 16;
-  h *= 0x7FEB352Du;
-  h ^= h >> 15;
-  h *= 0x846CA68Bu;
-  h ^= h >> 16;
+  const uint32_t h =
+      mix32(seed ^ (static_cast<uint32_t>(node) * 0x9E3779B1u) ^
+            (static_cast<uint32_t>(t) * 0x85EBCA77u) ^
+            (static_cast<uint32_t>(j) * 0xC2B2AE3Du));
   return h % static_cast<uint32_t>(deg > 0 ? deg : 1);
 }
 

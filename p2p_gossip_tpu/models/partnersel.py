@@ -55,6 +55,9 @@ def pick_index_jnp(node, tick, pick, degree, seed):
     scalars."""
     import jax.numpy as jnp
 
+    if isinstance(seed, int):
+        # A plain int >= 2**31 would overflow jnp.asarray's int32 default.
+        seed = np.uint32(seed & _MASK)
     h = (
         jnp.asarray(seed).astype(jnp.uint32)
         ^ (jnp.asarray(node).astype(jnp.uint32) * jnp.uint32(_C_NODE))

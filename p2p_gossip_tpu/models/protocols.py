@@ -266,7 +266,7 @@ def _run_partnered_sim(
 
     checkpointer = make_checkpointer(
         checkpoint_path, checkpoint_every, record_coverage,
-        (
+        lambda: (
             "partnered_sim", *fingerprint_extra, graph.n, graph.edges(),
             schedule.origins, schedule.gen_ticks, horizon_ticks, chunk_size,
             _canonical_delays(dg), dg.uniform_delay, dg.ring_size,

@@ -308,7 +308,7 @@ def run_sharded_partnered_sim(
 
     checkpointer = make_checkpointer(
         checkpoint_path, checkpoint_every, record_coverage,
-        (
+        lambda: (
             "sharded_partnered_sim", protocol,
             fanout if protocol == "pushk" else 1,
             graph.n, graph.edges(), schedule.origins, schedule.gen_ticks,

@@ -162,6 +162,30 @@ def available() -> bool:
     return load_library() is not None
 
 
+def _csr_delays(graph: Graph, ell_delays, constant_delay: int) -> np.ndarray:
+    """Per-edge delays in CSR order (the native engines' layout) from the
+    ELL-aligned array the Python engines use, or a constant fill."""
+    if ell_delays is not None:
+        rows, pos = graph.csr_rows_pos()
+        return np.ascontiguousarray(ell_delays[rows, pos], dtype=np.int32)
+    return np.full(graph.indices.shape[0], constant_delay, dtype=np.int32)
+
+
+def _marshal_churn(churn, n: int):
+    """(churn_k, start, end) C-contiguous int32 marshalling shared by the
+    native entry points (k=0 with 1-element dummies when churn is off)."""
+    if churn is None:
+        z = np.zeros(1, dtype=np.int32)
+        return 0, z, z
+    if churn.n != n:
+        raise ValueError(f"churn model is for {churn.n} nodes, graph has {n}")
+    return (
+        churn.k,
+        np.ascontiguousarray(churn.down_start, dtype=np.int32),
+        np.ascontiguousarray(churn.down_end, dtype=np.int32),
+    )
+
+
 def run_native_sim(
     graph: Graph,
     schedule: Schedule,
@@ -188,11 +212,7 @@ def run_native_sim(
         )
 
     n = graph.n
-    if ell_delays is not None:
-        rows, pos = graph.csr_rows_pos()
-        csr_delays = np.ascontiguousarray(ell_delays[rows, pos], dtype=np.int32)
-    else:
-        csr_delays = np.full(graph.indices.shape[0], constant_delay, dtype=np.int32)
+    csr_delays = _csr_delays(graph, ell_delays, constant_delay)
 
     generated = np.zeros(n, dtype=np.int64)
     received = np.zeros(n, dtype=np.int64)
@@ -209,17 +229,7 @@ def run_native_sim(
     )
     snap_gen = np.zeros(max(len(boundaries), 1), dtype=np.int64)
     snap_proc = np.zeros(max(len(boundaries), 1), dtype=np.int64)
-    if churn is not None:
-        if churn.n != n:
-            raise ValueError(
-                f"churn model is for {churn.n} nodes, graph has {n}"
-            )
-        churn_k = churn.k
-        churn_start = np.ascontiguousarray(churn.down_start, dtype=np.int32)
-        churn_end = np.ascontiguousarray(churn.down_end, dtype=np.int32)
-    else:
-        churn_k = 0
-        churn_start = churn_end = np.zeros(1, dtype=np.int32)
+    churn_k, churn_start, churn_end = _marshal_churn(churn, n)
     events = lib.gossip_run_event_sim(
         n,
         np.ascontiguousarray(graph.indptr, dtype=np.int64),
@@ -311,22 +321,10 @@ def run_native_partnered_sim(
         return stats
 
     n = graph.n
-    if ell_delays is not None:
-        rows, pos = graph.csr_rows_pos()
-        csr_delays = np.ascontiguousarray(ell_delays[rows, pos], dtype=np.int32)
-    else:
-        csr_delays = np.full(graph.indices.shape[0], constant_delay, dtype=np.int32)
+    csr_delays = _csr_delays(graph, ell_delays, constant_delay)
     received = np.zeros(n, dtype=np.int64)
     sent = np.zeros(n, dtype=np.int64)
-    if churn is not None:
-        if churn.n != n:
-            raise ValueError(f"churn model is for {churn.n} nodes, graph has {n}")
-        churn_k = churn.k
-        churn_start = np.ascontiguousarray(churn.down_start, dtype=np.int32)
-        churn_end = np.ascontiguousarray(churn.down_end, dtype=np.int32)
-    else:
-        churn_k = 0
-        churn_start = churn_end = np.zeros(1, dtype=np.int32)
+    churn_k, churn_start, churn_end = _marshal_churn(churn, n)
     rc = lib.gossip_run_partnered_sim(
         n,
         np.ascontiguousarray(graph.indptr, dtype=np.int64),

@@ -163,13 +163,15 @@ def checkpointed_chunks(chunks, checkpointer, stop_after_chunks=None):
 
 
 def make_checkpointer(
-    checkpoint_path, checkpoint_every, record_coverage, fp_parts, arrays
+    checkpoint_path, checkpoint_every, record_coverage, fp_parts_fn, arrays
 ):
     """Shared checkpoint setup for the partnered engines: returns None when
     checkpointing is off, rejects the record_coverage combination (a
     resumed run would be missing the skipped chunks' coverage history),
     and otherwise builds a ChunkCheckpointer over ``arrays`` keyed by
-    fingerprint(*fp_parts)."""
+    fingerprint(*fp_parts_fn()). ``fp_parts_fn`` is a thunk because some
+    fingerprint inputs (edge lists, canonical delay copies) are O(nnz) to
+    materialize — they must not be computed on checkpoint-free runs."""
     if checkpoint_path is None:
         return None
     if record_coverage:
@@ -178,5 +180,5 @@ def make_checkpointer(
             "resumed run would be missing the skipped chunks' coverage)"
         )
     return ChunkCheckpointer(
-        checkpoint_path, fingerprint(*fp_parts), arrays, checkpoint_every
+        checkpoint_path, fingerprint(*fp_parts_fn()), arrays, checkpoint_every
     )

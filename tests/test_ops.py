@@ -222,3 +222,23 @@ def test_bucketed_propagate_uniform_delay():
             )
         )
         np.testing.assert_array_equal(got, want)
+
+
+def test_partner_pick_hash_np_jnp_bitwise_equal():
+    """The partner-pick spec (models/partnersel.py) must evaluate
+    identically in numpy and jnp — the cross-engine/seeded-parity
+    foundation (the C++ leg is covered by the native parity tests)."""
+    import numpy as np
+
+    from p2p_gossip_tpu.models.partnersel import pick_index_jnp, pick_index_np
+
+    rng = np.random.default_rng(0)
+    nodes = rng.integers(0, 2**31 - 1, 500)
+    ticks = rng.integers(0, 100000, 500)
+    picks = rng.integers(0, 16, 500)
+    degs = rng.integers(0, 5000, 500)  # includes degree 0
+    for seed in (0, 1, 0xDEADBEEF, 2**32 - 1):
+        want = pick_index_np(nodes, ticks, picks, degs, seed)
+        got = np.asarray(pick_index_jnp(nodes, ticks, picks, degs, seed))
+        np.testing.assert_array_equal(got, want)
+        assert (want >= 0).all() and (want < np.maximum(degs, 1)).all()
