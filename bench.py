@@ -40,32 +40,11 @@ def main() -> None:
     # TPU optimum (ops/ell.py TUNED_TPU_BLOCK).
     chunk_size = 8192
 
-    # The TPU tunnel recovers from worker crashes with a delay; while it
-    # does, backend init either raises or HANGS. Probe in a subprocess
-    # (killable on hang) until the tunnel answers, so a wedge that clears
-    # doesn't cost the whole benchmark run.
-    import subprocess
+    # A wedged TPU tunnel hangs in-process backend init; wait it out with
+    # killable subprocess probes rather than losing the benchmark run.
+    from p2p_gossip_tpu.utils.platform import wait_for_device
 
-    probe = (
-        "import jax, jax.numpy as jnp; jax.devices(); "
-        "print(float(jnp.sum(jnp.ones((128, 128)))))"
-    )
-    for attempt in range(10):
-        try:
-            subprocess.run(
-                [sys.executable, "-c", probe],
-                check=True, timeout=180, capture_output=True,
-            )
-            break
-        except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
-            err = (e.stderr or b"").decode(errors="replace").strip()
-            log(
-                f"TPU probe attempt {attempt + 1}/10 failed: "
-                f"{type(e).__name__}: ...{err[-400:]}"
-            )
-            if attempt == 9:
-                raise
-            time.sleep(60)
+    wait_for_device()
     log(f"devices: {jax.devices()}")
     t0 = time.perf_counter()
     graph = native.native_erdos_renyi(n, p, seed=seed)

@@ -213,3 +213,39 @@ def run_event_sim(
     if arrival_ticks is not None:
         stats.extra["arrival_ticks"] = arrival_ticks
     return stats
+
+
+def run_event_partnered_sim(
+    graph: Graph,
+    schedule: Schedule,
+    horizon_ticks: int,
+    protocol: str = "pushpull",
+    fanout: int = 2,
+    seed: int = 0,
+    churn=None,
+    loss=None,
+) -> NodeStats:
+    """Pure-Python/numpy leg of the random-partner protocols: the numpy
+    oracles (models/protocols.py) driven by the host-replicated seeded
+    picks — no JAX, no native library, counters identical to every other
+    engine for the same seed. One-tick-delay model only (the oracles'
+    scope); per-edge delays need the jnp/native engines."""
+    from p2p_gossip_tpu.models.protocols import (
+        pushk_oracle,
+        pushpull_oracle,
+        seeded_partners,
+    )
+
+    if protocol == "pushpull":
+        picks = seeded_partners(graph, horizon_ticks, seed)
+        return pushpull_oracle(
+            graph, schedule, horizon_ticks, picks, churn=churn, loss=loss
+        )
+    if protocol == "pushk":
+        if fanout < 1:
+            raise ValueError(f"fanout must be >= 1, got {fanout}")
+        picks = seeded_partners(graph, horizon_ticks, seed, fanout=fanout)
+        return pushk_oracle(
+            graph, schedule, horizon_ticks, picks, churn=churn, loss=loss
+        )
+    raise ValueError(f"unknown protocol {protocol!r}")

@@ -89,7 +89,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--protocol", choices=("push", "pushpull", "pushk"), default="push",
         help="Gossip protocol: push flooding (reference), push-pull "
-        "anti-entropy, or fanout-limited push (both tpu backend only)",
+        "anti-entropy, or fanout-limited push — every protocol runs on "
+        "every backend with identical counters",
     )
     p.add_argument(
         "--fanout", type=int, default=2,
@@ -483,12 +484,15 @@ def run(argv=None) -> int:
             return 2
         return _run_flood_coverage_cli(args, g, horizon, delays, churn, loss)
 
-    if args.protocol in ("pushpull", "pushk") and args.backend not in (
-        "tpu", "sharded", "native"
+    if (
+        args.protocol in ("pushpull", "pushk")
+        and args.backend == "event"
+        and args.delayModel != "constant"
     ):
         print(
-            f"error: --protocol {args.protocol} requires --backend "
-            "tpu|sharded|native",
+            f"error: --protocol {args.protocol} --backend event supports "
+            "only --delayModel constant (the numpy oracle is the "
+            "one-tick-delay specification)",
             file=sys.stderr,
         )
         return 2
@@ -527,6 +531,13 @@ def run(argv=None) -> int:
         stats = run_native_partnered_sim(
             g, sched, horizon, protocol=args.protocol, fanout=args.fanout,
             ell_delays=delays, seed=args.seed, churn=churn, loss=loss,
+        )
+    elif args.protocol in ("pushpull", "pushk") and args.backend == "event":
+        from p2p_gossip_tpu.engine.event import run_event_partnered_sim
+
+        stats = run_event_partnered_sim(
+            g, sched, horizon, protocol=args.protocol, fanout=args.fanout,
+            seed=args.seed, churn=churn, loss=loss,
         )
     elif args.protocol == "pushpull":
         from p2p_gossip_tpu.models.protocols import run_pushpull_sim

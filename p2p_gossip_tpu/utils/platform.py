@@ -37,3 +37,46 @@ def force_cpu_backend_if_requested() -> None:
         pass
     # The plugin also pins jax_platforms via config, outranking the env var.
     jax.config.update("jax_platforms", "cpu")
+
+
+def wait_for_device(attempts: int = 10, probe_timeout: int = 180) -> None:
+    """Block until jax backend init will succeed, probing in a killable
+    subprocess — the TPU tunnel recovers from worker crashes with a long
+    delay, during which in-process init either raises or HANGS, so a
+    direct jax.devices() call can wedge the caller forever. No-op under
+    JAX_PLATFORMS=cpu (backend init never dials the tunnel once the
+    factory is deregistered). Raises after ``attempts`` failed probes.
+
+    Used by the benchmark/experiment scripts before their first device
+    query; diagnostics go to stderr.
+    """
+    import subprocess
+    import sys
+    import time
+
+    if cpu_requested():
+        force_cpu_backend_if_requested()
+        return
+    probe = (
+        "import jax, jax.numpy as jnp; jax.devices(); "
+        "print(float(jnp.sum(jnp.ones((128, 128)))))"
+    )
+    for attempt in range(attempts):
+        try:
+            subprocess.run(
+                [sys.executable, "-c", probe],
+                check=True, timeout=probe_timeout, capture_output=True,
+            )
+            return
+        except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
+            err = (getattr(e, "stderr", b"") or b"").decode(
+                errors="replace"
+            ).strip()
+            print(
+                f"device probe attempt {attempt + 1}/{attempts} failed: "
+                f"{type(e).__name__}: ...{err[-400:]}",
+                file=sys.stderr, flush=True,
+            )
+            if attempt == attempts - 1:
+                raise
+            time.sleep(60)

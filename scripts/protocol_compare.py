@@ -41,12 +41,14 @@ def main() -> None:
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
-    from p2p_gossip_tpu.utils.platform import force_cpu_backend_if_requested
+    from p2p_gossip_tpu.utils.platform import wait_for_device
 
-    force_cpu_backend_if_requested()
+    # CPU: deregisters the tunnel plugin. TPU: waits out a wedged tunnel
+    # with killable probes instead of hanging on first device query.
+    wait_for_device()
 
     import p2p_gossip_tpu as pg
-    from p2p_gossip_tpu.engine.sync import run_flood_coverage, time_to_coverage
+    from p2p_gossip_tpu.engine.sync import run_flood_coverage
     from p2p_gossip_tpu.models.generation import Schedule
     from p2p_gossip_tpu.models.protocols import run_pushk_sim, run_pushpull_sim
     from p2p_gossip_tpu.utils.analysis import (
@@ -64,15 +66,14 @@ def main() -> None:
         t0 = time.perf_counter()
         stats, cov = run()
         wall = time.perf_counter() - t0
-        ttc = time_to_coverage(cov, g.n, frac)
-        reached = ttc >= 0
         red = message_redundancy(stats)
-        rep = propagation_latency(cov, g.n, fractions=(frac,))
-        s = rep.summary(frac)
+        # All shares generate at t=0, so latency-to-coverage IS
+        # time-to-coverage — one computation serves both report fields.
+        s = propagation_latency(cov, g.n, fractions=(frac,)).summary(frac)
         return {
             "protocol": name,
-            "reached_fraction": float(reached.mean()),
-            "ttc_median_ticks": float(np.median(ttc[reached])) if reached.any() else -1,
+            "reached_fraction": s["reached"],
+            "ttc_median_ticks": s["median"],
             "final_coverage_mean": float(cov[-1].mean()),
             "sends_per_delivery": round(red["sends_per_delivery"], 2),
             "total_sent": int(stats.sent.sum()),

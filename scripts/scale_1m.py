@@ -71,33 +71,11 @@ def main() -> None:
     )
     from p2p_gossip_tpu.runtime import native
 
-    # The TPU tunnel recovers from worker crashes with a delay; while it
-    # does, backend init either raises or hangs — probe in a killable
-    # subprocess until it answers (same strategy as bench.py).
-    import subprocess
+    # A wedged TPU tunnel hangs in-process backend init; wait it out with
+    # killable subprocess probes (shared with bench.py).
+    from p2p_gossip_tpu.utils.platform import wait_for_device
 
-    probe = (
-        "import jax, jax.numpy as jnp; jax.devices(); "
-        "print(float(jnp.sum(jnp.ones((128, 128)))))"
-    )
-    for attempt in range(10):
-        try:
-            subprocess.run(
-                [sys.executable, "-c", probe],
-                check=True, timeout=180, capture_output=True,
-            )
-            break
-        except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
-            err = (getattr(e, "stderr", b"") or b"").decode(
-                errors="replace"
-            ).strip()
-            log(
-                f"TPU probe attempt {attempt + 1}/10 failed: "
-                f"{type(e).__name__}: ...{err[-400:]}"
-            )
-            if attempt == 9:
-                raise
-            time.sleep(60)
+    wait_for_device()
 
     # Initialize the TPU backend BEFORE the multi-GB graph load: the axon
     # tunnel plugin fails to register under the memory pressure / delay of

@@ -171,3 +171,35 @@ def test_fanout_validated_on_coverage_path(capsys):
     ])
     assert rc == 2
     assert "--fanout" in capsys.readouterr().err
+
+
+def test_partnered_protocols_on_every_backend(capsys):
+    """--protocol pushk produces identical totals on event, native, tpu
+    (CPU-pinned), and sharded backends — the four-engine parity contract
+    from the CLI."""
+    from p2p_gossip_tpu.utils.cli import run
+
+    common = [
+        "--numNodes", "40", "--connectionProb", "0.15", "--simTime", "2",
+        "--Latency", "5", "--seed", "6", "--protocol", "pushk",
+        "--fanout", "2", "--chunkSize", "32",
+    ]
+    outs = {}
+    for backend in ("event", "native", "tpu", "sharded"):
+        rc = run(common + ["--backend", backend])
+        out = capsys.readouterr().out
+        assert rc == 0, backend
+        totals = [ln for ln in out.splitlines() if ln.startswith("Total ")]
+        assert totals, backend
+        outs[backend] = totals
+    assert outs["event"] == outs["native"] == outs["tpu"] == outs["sharded"]
+
+
+def test_partnered_event_backend_rejects_lognormal(capsys):
+    from p2p_gossip_tpu.utils.cli import run
+
+    rc = run([
+        "--numNodes", "20", "--protocol", "pushpull", "--backend", "event",
+        "--delayModel", "lognormal",
+    ])
+    assert rc == 2
