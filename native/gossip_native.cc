@@ -101,7 +101,7 @@ extern "C" {
 // Bump whenever any exported signature changes. runtime/native.py refuses a
 // library whose version doesn't match (a stale .so bound with the wrong
 // argument layout would corrupt memory) and falls back to the Python engine.
-int64_t gossip_abi_version() { return 4; }
+int64_t gossip_abi_version() { return 5; }
 
 // Runs the event-driven simulation. Returns the number of events processed
 // (heap pops), the metric NS-3-style engines are measured by. Snapshot
@@ -195,10 +195,10 @@ int64_t gossip_run_event_sim(
   return events;
 }
 
-// Round-based random-partner protocols (push-pull anti-entropy and
-// fanout-limited push) — the C++ leg of the cross-engine parity contract
-// with models/protocols.py (single-device jnp), the numpy oracles, and the
-// shard_map mesh engine. Same semantics, tick for tick:
+// Round-based random-partner protocols (push-pull / pull-only anti-entropy
+// and fanout-limited push) — the C++ leg of the cross-engine parity
+// contract with models/protocols.py (single-device jnp), the numpy
+// oracles, and the shard_map mesh engine. Same semantics, tick for tick:
 //   * each round every node with degree > 0 makes its counter-hash partner
 //     pick(s); an exchange with a down endpoint never happens; loss drops
 //     each direction in flight (sender still counts);
@@ -208,18 +208,21 @@ int64_t gossip_run_event_sim(
 //     round;
 //   * fanout push (protocol 1): the ring holds past FRONTIERS (newly|gen);
 //     each of `fanout` picks pushes my frontier as of that edge's delay;
-//     one send per attempted pick, costed at the pushed frontier popcount.
+//     one send per attempted pick, costed at the pushed frontier popcount;
+//   * pull-only (protocol 2): the pull direction alone; `sent` credits the
+//     RESPONDER with the popcount of the state it serves (before loss —
+//     in-flight loss doesn't refund the transmitter).
 // Returns the number of rounds executed (== horizon), or -1 on bad args.
 int64_t gossip_run_partnered_sim(
     int64_t n, const int64_t* indptr, const int32_t* indices,
     const int32_t* csr_delays, int64_t num_shares, const int32_t* origins,
     const int32_t* gen_ticks, int64_t horizon,
-    int64_t protocol,  // 0 = pushpull, 1 = pushk
+    int64_t protocol,  // 0 = pushpull, 1 = pushk, 2 = pull
     int64_t fanout, int64_t pick_seed,
     int64_t churn_k, const int32_t* churn_start, const int32_t* churn_end,
     int64_t loss_threshold, int64_t loss_seed,
     int64_t* out_received, int64_t* out_sent) {
-  if (protocol < 0 || protocol > 1 || (protocol == 1 && fanout < 1)) return -1;
+  if (protocol < 0 || protocol > 2 || (protocol == 1 && fanout < 1)) return -1;
   std::fill(out_received, out_received + n, 0);
   std::fill(out_sent, out_sent + n, 0);
 
@@ -236,7 +239,9 @@ int64_t gossip_run_partnered_sim(
 
   const uint32_t pseed = static_cast<uint32_t>(pick_seed);
   const uint32_t lseed = static_cast<uint32_t>(loss_seed);
-  const int64_t k = protocol == 0 ? 1 : fanout;
+  // Anti-entropy (push-pull and pull-only) makes ONE pick per round; only
+  // fanout push uses k picks.
+  const int64_t k = protocol == 1 ? fanout : 1;
 
   for (int64_t t = 0; t < horizon; ++t) {
     if (churn_k > 0) {
@@ -261,9 +266,23 @@ int64_t gossip_run_partnered_sim(
         const int64_t partner = indices[e];
         const int64_t slot =
             ((t - csr_delays[e]) % ring + ring) % ring;
-        const uint64_t* mine = &hist[(slot * n + i) * words];
         const bool attempted = up[i] && up[partner];
         if (!attempted) continue;
+        if (protocol == 2) {
+          // Pull-only: responder credit + the pull direction.
+          const uint64_t* remote = &hist[(slot * n + partner) * words];
+          int64_t cnt = 0;
+          for (int64_t w = 0; w < words; ++w) {
+            cnt += __builtin_popcountll(remote[w]);
+          }
+          out_sent[partner] += cnt;
+          if (!loss_drop(partner, i, t, loss_threshold, lseed)) {
+            uint64_t* dst = &incoming[i * words];
+            for (int64_t w = 0; w < words; ++w) dst[w] |= remote[w];
+          }
+          continue;
+        }
+        const uint64_t* mine = &hist[(slot * n + i) * words];
         int64_t cnt = 0;
         for (int64_t w = 0; w < words; ++w) {
           cnt += __builtin_popcountll(mine[w]);
@@ -307,8 +326,9 @@ int64_t gossip_run_partnered_sim(
         front[o * words + (s >> 6)] |= 1ull << (s & 63);
       }
     }
-    if (protocol == 0) {
-      // The ring holds full seen-states (post-gen, like the engines).
+    if (protocol != 1) {
+      // Anti-entropy: the ring holds full seen-states (post-gen, like the
+      // engines).
       std::memcpy(front, seen.data(),
                   static_cast<size_t>(n) * words * sizeof(uint64_t));
     }

@@ -236,10 +236,11 @@ def run_event_partnered_sim(
         seeded_partners,
     )
 
-    if protocol == "pushpull":
+    if protocol in ("pushpull", "pull"):
         picks = seeded_partners(graph, horizon_ticks, seed)
         return pushpull_oracle(
-            graph, schedule, horizon_ticks, picks, churn=churn, loss=loss
+            graph, schedule, horizon_ticks, picks, churn=churn, loss=loss,
+            mode=protocol,
         )
     if protocol == "pushk":
         if fanout < 1:

@@ -58,7 +58,7 @@ def _select_partners(seed, t, ell_idx, ell_delay, degree, node_ids=None):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("chunk_size", "horizon", "record_coverage", "loss"),
+    static_argnames=("chunk_size", "horizon", "record_coverage", "loss", "mode"),
 )
 def _run_pushpull(
     dg: DeviceGraph,
@@ -72,6 +72,7 @@ def _run_pushpull(
     horizon: int,
     record_coverage: bool = False,
     loss: tuple | None = None,
+    mode: str = "pushpull",           # "pushpull" | "pull"
 ):
     n, w = dg.n, bitmask.num_words(chunk_size)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
@@ -124,23 +125,38 @@ def _run_pushpull(
             thr, lseed = loss
             pull_ok = attempted & ~drop_mask_jnp(partners, rows, t, thr, lseed)
             push_ok = attempted & ~drop_mask_jnp(rows, partners, t, thr, lseed)
+        # Responder's transmission cost of serving i's pull, counted
+        # before loss (in-flight loss doesn't refund the sender).
+        pc_remote = bitmask.popcount_rows(remote)
         remote = jnp.where(pull_ok[:, None], remote, jnp.uint32(0))
-        pushed = scatter_or(
-            n, partners, jnp.where(push_ok[:, None], my_old, jnp.uint32(0))
-        )
+        if mode == "pull":
+            pushed = jnp.uint32(0)
+        else:
+            pushed = scatter_or(
+                n, partners, jnp.where(push_ok[:, None], my_old, jnp.uint32(0))
+            )
         gen_active = gen_ticks == t
         if churn is not None:
             gen_active = gen_active & up[origins]
         gen_bits = bitmask.slot_scatter(n, w, origins, slots, gen_active)
         incoming = (remote | pushed) & ~seen
         newly_cnt = bitmask.popcount_rows(incoming)
-        # One digest per attempted round to one partner; loss drops in
-        # flight, so the sender still counts (64-bit accumulation: digest
-        # popcounts reach num_shares per round, horizon rounds overflow i32).
-        sent_lo, sent_hi = bitmask.add_u64(
-            sent_lo, sent_hi,
-            jnp.where(attempted, bitmask.popcount_rows(my_old), 0),
-        )
+        # Digest accounting (64-bit pairs: digest popcounts reach num_shares
+        # per round, horizon rounds overflow i32). Push-pull: one digest per
+        # attempted round from i to its partner. Pull: the RESPONDER is the
+        # transmitter — each attempted pull credits the partner with the
+        # popcount of the state it served.
+        if mode == "pull":
+            sent_add = (
+                jnp.zeros((n,), dtype=jnp.int32)
+                .at[partners]
+                .add(jnp.where(attempted, pc_remote, 0))
+            )
+        else:
+            sent_add = jnp.where(
+                attempted, bitmask.popcount_rows(my_old), 0
+            )
+        sent_lo, sent_hi = bitmask.add_u64(sent_lo, sent_hi, sent_add)
         seen = seen | incoming | gen_bits
         received = received + newly_cnt
         hist = hist.at[jnp.mod(t, ring)].set(seen)
@@ -174,8 +190,16 @@ def run_pushpull_sim(
     checkpoint_path: str | None = None,
     checkpoint_every: int = 1,
     stop_after_chunks: int | None = None,
+    mode: str = "pushpull",
 ):
     """Push-pull anti-entropy for ``horizon_ticks`` rounds.
+
+    ``mode="pull"`` runs pull-only anti-entropy (the third of Demers'
+    push/pull/push-pull trio; our flood engine is the eager-push leg):
+    each round node n ORs in its partner's past state but pushes nothing.
+    Counter mapping for pull: ``sent`` credits the RESPONDER — each
+    attempted pull adds the popcount of the served state to the partner's
+    ``sent`` (in-flight loss doesn't refund it).
 
     Shares are processed in fixed-size chunks like the sync engine; partner
     selection is keyed only by (seed, round), so every chunk sees the same
@@ -200,8 +224,14 @@ def run_pushpull_sim(
     combinable with ``record_coverage`` — a resumed run would be missing
     the skipped chunks' coverage history).
     """
+    if mode not in ("pushpull", "pull"):
+        raise ValueError(f"unknown anti-entropy mode {mode!r}")
+    # Fingerprint key: ("pushpull",) for the default mode — unchanged from
+    # before pull existed, so old push-pull checkpoints still resume.
+    fp_extra = ("pushpull",) if mode == "pushpull" else ("pull",)
     return _run_partnered_sim(
-        _run_pushpull, ("pushpull",), graph, schedule, horizon_ticks,
+        functools.partial(_run_pushpull, mode=mode), fp_extra,
+        graph, schedule, horizon_ticks,
         ell_delays, constant_delay, seed, record_coverage, partners_override,
         device_graph, chunk_size, churn, loss,
         checkpoint_path, checkpoint_every, stop_after_chunks,
@@ -322,11 +352,12 @@ def pushpull_oracle(
     partners: np.ndarray,
     churn=None,
     loss=None,
+    mode: str = "pushpull",
 ) -> NodeStats:
-    """Plain-numpy specification of one-tick-delay push-pull with pinned
-    partner choices — the oracle the TPU engine is tested against,
-    including under churn and link-loss models (same gating rules as
-    `_run_pushpull`)."""
+    """Plain-numpy specification of one-tick-delay push-pull (or pull-only,
+    ``mode="pull"``) with pinned partner choices — the oracle the TPU
+    engine is tested against, including under churn and link-loss models
+    (same gating and counter rules as `_run_pushpull`)."""
     from p2p_gossip_tpu.models.linkloss import drop_mask_np
 
     n = graph.n
@@ -352,10 +383,14 @@ def pushpull_oracle(
                 rows, p, t, loss.threshold, loss.seed
             )
         incoming = old[p] & pull_ok[:, None]  # pull
-        for i in range(n):  # push
-            if push_ok[i]:
-                incoming[p[i]] = incoming[p[i]] | old[i]
-        sent += np.where(attempted, old.sum(axis=1), 0)
+        if mode == "pull":
+            # Responder credit: serving i's pull transmits p[i]'s state.
+            np.add.at(sent, p, np.where(attempted, old[p].sum(axis=1), 0))
+        else:
+            for i in range(n):  # push
+                if push_ok[i]:
+                    incoming[p[i]] = incoming[p[i]] | old[i]
+            sent += np.where(attempted, old.sum(axis=1), 0)
         newly = incoming & ~seen
         received += newly.sum(axis=1)
         seen |= newly

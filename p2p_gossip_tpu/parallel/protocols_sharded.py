@@ -61,7 +61,7 @@ def _reduce_scatter_or(pushed_global: jnp.ndarray, n_shards: int, n_loc: int):
 @functools.lru_cache(maxsize=32)
 def build_partnered_runner(
     mesh: Mesh,
-    protocol: str,            # "pushpull" | "pushk"
+    protocol: str,            # "pushpull" | "pull" | "pushk"
     n_padded: int,
     ring_size: int,
     chunk_size: int,
@@ -76,7 +76,7 @@ def build_partnered_runner(
     Counters come back stacked per share-shard — (n_share_shards, n_padded)
     int32 received and uint32 sent lo/hi pairs — and the host folds them in
     int64 (a psum of the raw u64 halves would drop carries)."""
-    if protocol not in ("pushpull", "pushk"):
+    if protocol not in ("pushpull", "pull", "pushk"):
         raise ValueError(f"unknown protocol {protocol!r}")
     if fanout < 1:
         raise ValueError(f"fanout must be >= 1, got {fanout}")
@@ -85,6 +85,10 @@ def build_partnered_runner(
     n_loc = n_padded // n_node_shards
     w = bitmask.num_words(chunk_size)
     k = fanout if protocol == "pushk" else 1
+    # "pushpull" and "pull" share the anti-entropy shape (one partner, ring
+    # of seen-states); "pull" skips the push direction and credits `sent`
+    # to the responder (see run_pushpull_sim's mode="pull" docs).
+    anti = protocol in ("pushpull", "pull")
 
     def pass_fn(
         ell_idx, ell_delay, degree, churn_start, churn_end,
@@ -115,11 +119,10 @@ def build_partnered_runner(
         def body(t, state):
             seen, hist, received, sent_lo, sent_hi, cov_hist = state
             t = jnp.int32(t)
-            if protocol == "pushpull":
+            if anti:
                 kidx = pick_index_jnp(node_ids, t, 0, degree, seed)
                 partners = ell_idx[rows_l, kidx]          # (n_loc,) global
                 delay = ell_delay[rows_l, kidx]
-                pick_shape = (n_loc,)
             else:
                 picks = jnp.arange(k, dtype=jnp.int32)[None, :]
                 kidx = pick_index_jnp(
@@ -127,23 +130,20 @@ def build_partnered_runner(
                 )
                 partners = ell_idx[rows_l[:, None], kidx]  # (n_loc, k)
                 delay = ell_delay[rows_l[:, None], kidx]
-                pick_shape = (n_loc, k)
 
             flat = hist.reshape(ring_size * n_padded, w)
             slot = jnp.mod(t - delay, ring_size)
-            if protocol == "pushpull":
+            if anti:
                 remote = flat[slot * n_padded + partners]          # pull
                 my_old = flat[slot * n_padded + node_ids]          # push
             else:
                 my_old = flat[slot * n_padded + node_ids[:, None]]  # (n_loc,k,W)
 
             up = up_mask_jnp(churn_start, churn_end, t)   # (n_padded,)
-            self_ids = (
-                node_ids if protocol == "pushpull" else node_ids[:, None]
-            )
+            self_ids = node_ids if anti else node_ids[:, None]
             attempted = (
                 up[self_ids] & up[partners]
-                & (live_row if protocol == "pushpull" else live_row[:, None])
+                & (live_row if anti else live_row[:, None])
             )
             pull_ok = push_ok = attempted
             if loss is not None:
@@ -151,20 +151,39 @@ def build_partnered_runner(
                 push_ok = attempted & ~drop_mask_jnp(
                     self_ids, partners, t, thr, lseed
                 )
-                if protocol == "pushpull":
+                if anti:
                     pull_ok = attempted & ~drop_mask_jnp(
                         partners, node_ids, t, thr, lseed
                     )
 
-            if protocol == "pushpull":
+            if anti:
+                # Responder credit for pull mode, before loss masking.
+                pc_remote = bitmask.popcount_rows(remote)
                 remote = jnp.where(pull_ok[:, None], remote, jnp.uint32(0))
-                pushed = scatter_or(
-                    n_padded, partners,
-                    jnp.where(push_ok[:, None], my_old, jnp.uint32(0)),
-                )
-                sent_add = jnp.where(
-                    attempted, bitmask.popcount_rows(my_old), 0
-                )
+                if protocol == "pull":
+                    pushed_local = jnp.zeros((n_loc, w), dtype=jnp.uint32)
+                    # Each attempted pull credits the (possibly remote)
+                    # responder; contributions sum across node shards.
+                    sent_add = lax.dynamic_slice_in_dim(
+                        lax.psum(
+                            jnp.zeros((n_padded,), dtype=jnp.int32)
+                            .at[partners]
+                            .add(jnp.where(attempted, pc_remote, 0)),
+                            NODES_AXIS,
+                        ),
+                        row_offset, n_loc,
+                    )
+                else:
+                    pushed = scatter_or(
+                        n_padded, partners,
+                        jnp.where(push_ok[:, None], my_old, jnp.uint32(0)),
+                    )
+                    pushed_local = _reduce_scatter_or(
+                        pushed, n_node_shards, n_loc
+                    )
+                    sent_add = jnp.where(
+                        attempted, bitmask.popcount_rows(my_old), 0
+                    )
             else:
                 payload_ok = jnp.where(
                     push_ok[..., None], my_old, jnp.uint32(0)
@@ -173,13 +192,13 @@ def build_partnered_runner(
                     n_padded, partners.reshape(-1),
                     payload_ok.reshape(n_loc * k, w),
                 )
+                pushed_local = _reduce_scatter_or(pushed, n_node_shards, n_loc)
                 pick_cnt = bitmask.popcount_rows(
                     my_old.reshape(n_loc * k, w)
                 ).reshape(n_loc, k)
                 remote = jnp.uint32(0)
                 sent_add = jnp.sum(jnp.where(attempted, pick_cnt, 0), axis=1)
 
-            pushed_local = _reduce_scatter_or(pushed, n_node_shards, n_loc)
             sent_lo, sent_hi = bitmask.add_u64(sent_lo, sent_hi, sent_add)
 
             local_origin_rows = origins - row_offset
@@ -189,7 +208,7 @@ def build_partnered_runner(
                 n_loc, w, local_origin_rows, slots, gen_active
             )
 
-            if protocol == "pushpull":
+            if anti:
                 incoming = (remote | pushed_local) & ~seen
                 received = received + bitmask.popcount_rows(incoming)
                 seen = seen | incoming | gen_bits
@@ -274,7 +293,7 @@ def run_sharded_partnered_sim(
     a different mesh starts fresh; not combinable with
     ``record_coverage``).
     """
-    if protocol not in ("pushpull", "pushk"):
+    if protocol not in ("pushpull", "pull", "pushk"):
         raise ValueError(f"unknown protocol {protocol!r}")
     n_node_shards = mesh.shape[NODES_AXIS]
     chunk_size = min(chunk_size, max(MIN_CHUNK_SHARES, schedule.num_shares))

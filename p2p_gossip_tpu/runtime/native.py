@@ -31,7 +31,7 @@ _lib_checked = False
 # Must match gossip_abi_version() in native/gossip_native.cc. Binding a stale
 # .so with a different argument layout would scribble over the wrong buffers,
 # so a mismatch is treated as "not built".
-ABI_VERSION = 4
+ABI_VERSION = 5
 
 
 def _try_autobuild() -> None:
@@ -135,7 +135,7 @@ def _configure(lib) -> None:
         i32p,                        # origins
         i32p,                        # gen_ticks
         ctypes.c_int64,              # horizon
-        ctypes.c_int64,              # protocol (0 = pushpull, 1 = pushk)
+        ctypes.c_int64,              # protocol (0=pushpull, 1=pushk, 2=pull)
         ctypes.c_int64,              # fanout
         ctypes.c_int64,              # pick_seed
         ctypes.c_int64,              # churn_k
@@ -294,7 +294,7 @@ def run_native_partnered_sim(
     / run_pushk_sim for the same seed (partner picks and loss coins are the
     shared counter-hash specs), including under churn and link loss. Falls
     back to the jnp engines when unbuilt."""
-    if protocol not in ("pushpull", "pushk"):
+    if protocol not in ("pushpull", "pull", "pushk"):
         raise ValueError(f"unknown protocol {protocol!r}")
     lib = load_library()
     if lib is None:
@@ -306,11 +306,11 @@ def run_native_partnered_sim(
             run_pushpull_sim,
         )
 
-        if protocol == "pushpull":
+        if protocol in ("pushpull", "pull"):
             stats, _ = run_pushpull_sim(
                 graph, schedule, horizon_ticks, ell_delays=ell_delays,
                 constant_delay=constant_delay, seed=seed, churn=churn,
-                loss=loss,
+                loss=loss, mode=protocol,
             )
         else:
             stats, _ = run_pushk_sim(
@@ -334,7 +334,7 @@ def run_native_partnered_sim(
         np.ascontiguousarray(schedule.origins, dtype=np.int32),
         np.ascontiguousarray(schedule.gen_ticks, dtype=np.int32),
         horizon_ticks,
-        0 if protocol == "pushpull" else 1,
+        {"pushpull": 0, "pushk": 1, "pull": 2}[protocol],
         fanout,
         int(seed) & 0xFFFFFFFF,
         churn_k,

@@ -87,10 +87,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="Columns for --topology grid/torus (default: ~sqrt(numNodes))",
     )
     p.add_argument(
-        "--protocol", choices=("push", "pushpull", "pushk"), default="push",
+        "--protocol", choices=("push", "pushpull", "pull", "pushk"),
+        default="push",
         help="Gossip protocol: push flooding (reference), push-pull "
-        "anti-entropy, or fanout-limited push — every protocol runs on "
-        "every backend with identical counters",
+        "anti-entropy, pull-only anti-entropy, or fanout-limited push — "
+        "every protocol runs on every backend with identical counters",
     )
     p.add_argument(
         "--fanout", type=int, default=2,
@@ -201,7 +202,7 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
             f"Mesh: {mesh.shape['shares']} share-shards x "
             f"{mesh.shape['nodes']} node-shards"
         )
-    if args.protocol in ("pushpull", "pushk"):
+    if args.protocol in ("pushpull", "pull", "pushk"):
         from p2p_gossip_tpu.models.generation import Schedule
 
         sched = Schedule(g.n, origins, np.zeros(len(origins), dtype=np.int32))
@@ -223,7 +224,11 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
                 run_pushpull_sim,
             )
 
-            run = run_pushpull_sim if args.protocol == "pushpull" else run_pushk_sim
+            if args.protocol == "pushk":
+                run = run_pushk_sim
+            else:
+                run = run_pushpull_sim
+                kw = dict(mode=args.protocol)
             stats, coverage = run(
                 g, sched, horizon, ell_delays=delays, seed=args.seed,
                 chunk_size=args.chunkSize, churn=churn, loss=loss,
@@ -485,7 +490,7 @@ def run(argv=None) -> int:
         return _run_flood_coverage_cli(args, g, horizon, delays, churn, loss)
 
     if (
-        args.protocol in ("pushpull", "pushk")
+        args.protocol in ("pushpull", "pull", "pushk")
         and args.backend == "event"
         and args.delayModel != "constant"
     ):
@@ -507,7 +512,7 @@ def run(argv=None) -> int:
         return 2
 
     t0 = time.perf_counter()
-    if args.protocol in ("pushpull", "pushk") and args.backend == "sharded":
+    if args.protocol in ("pushpull", "pull", "pushk") and args.backend == "sharded":
         from p2p_gossip_tpu.parallel.mesh import make_mesh
         from p2p_gossip_tpu.parallel.protocols_sharded import (
             run_sharded_partnered_sim,
@@ -525,21 +530,21 @@ def run(argv=None) -> int:
             checkpoint_path=args.checkpoint or None,
             checkpoint_every=args.checkpointEvery,
         )
-    elif args.protocol in ("pushpull", "pushk") and args.backend == "native":
+    elif args.protocol in ("pushpull", "pull", "pushk") and args.backend == "native":
         from p2p_gossip_tpu.runtime.native import run_native_partnered_sim
 
         stats = run_native_partnered_sim(
             g, sched, horizon, protocol=args.protocol, fanout=args.fanout,
             ell_delays=delays, seed=args.seed, churn=churn, loss=loss,
         )
-    elif args.protocol in ("pushpull", "pushk") and args.backend == "event":
+    elif args.protocol in ("pushpull", "pull", "pushk") and args.backend == "event":
         from p2p_gossip_tpu.engine.event import run_event_partnered_sim
 
         stats = run_event_partnered_sim(
             g, sched, horizon, protocol=args.protocol, fanout=args.fanout,
             seed=args.seed, churn=churn, loss=loss,
         )
-    elif args.protocol == "pushpull":
+    elif args.protocol in ("pushpull", "pull"):
         from p2p_gossip_tpu.models.protocols import run_pushpull_sim
 
         stats, _ = run_pushpull_sim(
@@ -547,6 +552,7 @@ def run(argv=None) -> int:
             chunk_size=args.chunkSize, churn=churn, loss=loss,
             checkpoint_path=args.checkpoint or None,
             checkpoint_every=args.checkpointEvery,
+            mode=args.protocol,
         )
     elif args.protocol == "pushk":
         from p2p_gossip_tpu.models.protocols import run_pushk_sim

@@ -93,6 +93,13 @@ def main() -> None:
             ),
         ),
         measure(
+            "pull",
+            lambda: run_pushpull_sim(
+                g, sched, args.horizon, seed=args.seed, record_coverage=True,
+                mode="pull",
+            ),
+        ),
+        measure(
             f"pushk(k={args.fanout})",
             lambda: run_pushk_sim(
                 g, sched, args.horizon, fanout=args.fanout, seed=args.seed,

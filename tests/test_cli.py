@@ -173,19 +173,25 @@ def test_fanout_validated_on_coverage_path(capsys):
     assert "--fanout" in capsys.readouterr().err
 
 
-def test_partnered_protocols_on_every_backend(capsys):
-    """--protocol pushk produces identical totals on event, native, tpu
-    (CPU-pinned), and sharded backends — the four-engine parity contract
-    from the CLI."""
+@pytest.mark.parametrize(
+    "proto_args",
+    [["--protocol", "pushk", "--fanout", "2"],
+     ["--protocol", "pull"],
+     ["--protocol", "pushpull"]],
+    ids=["pushk", "pull", "pushpull"],
+)
+def test_partnered_protocols_on_every_backend(capsys, proto_args):
+    """Each partnered protocol produces identical totals on event, native,
+    tpu (CPU-pinned), and sharded backends — the four-engine parity
+    contract from the CLI."""
     from p2p_gossip_tpu.utils.cli import run
 
     common = [
         "--numNodes", "40", "--connectionProb", "0.15", "--simTime", "2",
-        "--Latency", "5", "--seed", "6", "--protocol", "pushk",
-        "--fanout", "2", "--chunkSize", "32",
-    ]
+        "--Latency", "5", "--seed", "6", "--chunkSize", "32",
+    ] + proto_args
     outs = {}
-    for backend in ("event", "native", "tpu", "sharded"):
+    for backend in ("event", "native", "tpu", "sharded"):  # all four
         rc = run(common + ["--backend", backend])
         out = capsys.readouterr().out
         assert rc == 0, backend

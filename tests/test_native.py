@@ -127,4 +127,35 @@ def test_native_partnered_rejects_bad_args():
     g = pg.erdos_renyi(16, 0.3, seed=0)
     sched = single_share_schedule(g.n, origin=0)
     with pytest.raises(ValueError):
-        run_native_partnered_sim(g, sched, 4, protocol="pull")
+        run_native_partnered_sim(g, sched, 4, protocol="flood")
+
+
+def test_native_pull_matches_jnp_engine():
+    from p2p_gossip_tpu.models.churn import ChurnModel
+    from p2p_gossip_tpu.models.generation import Schedule
+    from p2p_gossip_tpu.models.latency import lognormal_delays
+    from p2p_gossip_tpu.models.linkloss import LinkLossModel
+    from p2p_gossip_tpu.models.protocols import run_pushpull_sim
+    from p2p_gossip_tpu.runtime.native import run_native_partnered_sim
+
+    if not native.available():
+        pytest.skip("native library not built")
+    g = pg.erdos_renyi(50, 0.12, seed=4)
+    sched = Schedule(
+        g.n,
+        np.array([0, 9, 21], dtype=np.int32),
+        np.array([0, 1, 4], dtype=np.int32),
+    )
+    horizon, seed = 16, 42
+    delays = lognormal_delays(g, 2.0, 0.5, max_ticks=4, seed=5)
+    down_start = np.zeros((g.n, 1), dtype=np.int32)
+    down_end = np.zeros((g.n, 1), dtype=np.int32)
+    down_start[5, 0], down_end[5, 0] = 3, 12
+    churn = ChurnModel(n=g.n, down_start=down_start, down_end=down_end)
+    loss = LinkLossModel(0.3, seed=9)
+    for kw in (dict(), dict(ell_delays=delays, churn=churn, loss=loss)):
+        want, _ = run_pushpull_sim(g, sched, horizon, seed=seed, mode="pull", **kw)
+        got = run_native_partnered_sim(
+            g, sched, horizon, protocol="pull", seed=seed, **kw
+        )
+        assert got.equal_counts(want), kw.keys()

