@@ -147,10 +147,15 @@ def _run_pushpull(
         # transmitter — each attempted pull credits the partner with the
         # popcount of the state it served.
         if mode == "pull":
+            # uint32 accumulator: a responder's per-round credit is bounded
+            # by degree x chunk_size, which the driver guards below 2^32
+            # (an int32 scatter would wrap at half that).
             sent_add = (
-                jnp.zeros((n,), dtype=jnp.int32)
+                jnp.zeros((n,), dtype=jnp.uint32)
                 .at[partners]
-                .add(jnp.where(attempted, pc_remote, 0))
+                .add(
+                    jnp.where(attempted, pc_remote, 0).astype(jnp.uint32)
+                )
             )
         else:
             sent_add = jnp.where(
@@ -226,16 +231,30 @@ def run_pushpull_sim(
     """
     if mode not in ("pushpull", "pull"):
         raise ValueError(f"unknown anti-entropy mode {mode!r}")
-    # Fingerprint key: ("pushpull",) for the default mode — unchanged from
-    # before pull existed, so old push-pull checkpoints still resume.
-    fp_extra = ("pushpull",) if mode == "pushpull" else ("pull",)
+    if mode == "pull":
+        _check_pull_credit_bound(graph, chunk_size, schedule)
     return _run_partnered_sim(
-        functools.partial(_run_pushpull, mode=mode), fp_extra,
+        functools.partial(_run_pushpull, mode=mode), (mode,),
         graph, schedule, horizon_ticks,
         ell_delays, constant_delay, seed, record_coverage, partners_override,
         device_graph, chunk_size, churn, loss,
         checkpoint_path, checkpoint_every, stop_after_chunks,
     )
+
+
+def _check_pull_credit_bound(graph: Graph, chunk_size: int, schedule) -> None:
+    """Pull mode's per-round responder credit is bounded by
+    degree x chunk_size (every attempted puller of one hub, each served a
+    full chunk); the uint32 scatter accumulator wraps at 2^32. Enforce the
+    exact precondition instead of silently corrupting ``sent``."""
+    eff_chunk = min(chunk_size, max(MIN_CHUNK_SHARES, schedule.num_shares))
+    eff_chunk = bitmask.num_words(eff_chunk) * bitmask.WORD_BITS
+    if int(graph.max_degree) * eff_chunk >= 1 << 32:
+        raise ValueError(
+            "pull-mode per-round sent credit may overflow uint32: "
+            f"max degree {graph.max_degree} x chunk {eff_chunk} >= 2^32 — "
+            "reduce chunk_size"
+        )
 
 
 def _run_partnered_sim(

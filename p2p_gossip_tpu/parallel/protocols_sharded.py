@@ -164,11 +164,16 @@ def build_partnered_runner(
                     pushed_local = jnp.zeros((n_loc, w), dtype=jnp.uint32)
                     # Each attempted pull credits the (possibly remote)
                     # responder; contributions sum across node shards.
+                    # uint32 accumulator — the driver guards
+                    # degree x chunk < 2^32 (see _check_pull_credit_bound).
                     sent_add = lax.dynamic_slice_in_dim(
                         lax.psum(
-                            jnp.zeros((n_padded,), dtype=jnp.int32)
+                            jnp.zeros((n_padded,), dtype=jnp.uint32)
                             .at[partners]
-                            .add(jnp.where(attempted, pc_remote, 0)),
+                            .add(
+                                jnp.where(attempted, pc_remote, 0)
+                                .astype(jnp.uint32)
+                            ),
                             NODES_AXIS,
                         ),
                         row_offset, n_loc,
@@ -295,6 +300,10 @@ def run_sharded_partnered_sim(
     """
     if protocol not in ("pushpull", "pull", "pushk"):
         raise ValueError(f"unknown protocol {protocol!r}")
+    if protocol == "pull":
+        from p2p_gossip_tpu.models.protocols import _check_pull_credit_bound
+
+        _check_pull_credit_bound(graph, chunk_size, schedule)
     n_node_shards = mesh.shape[NODES_AXIS]
     chunk_size = min(chunk_size, max(MIN_CHUNK_SHARES, schedule.num_shares))
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS

@@ -59,7 +59,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="Share-axis shards for --backend sharded",
     )
     p.add_argument(
-        "--topology", choices=("er", "ba", "ring", "ws", "grid", "torus"),
+        "--topology",
+        choices=("er", "ba", "ring", "ws", "grid", "torus", "complete"),
         default="er",
         help="Topology family (er = reference's random topology; ws = "
         "Watts-Strogatz small-world; grid/torus = 2D lattice)",
@@ -372,6 +373,8 @@ def run(argv=None) -> int:
             )
             return 2
         g = topo.grid_graph(rows, cols, torus=args.topology == "torus")
+    elif args.topology == "complete":
+        g = topo.complete_graph(args.numNodes)
     else:
         g = topo.ring_graph(args.numNodes)
 

@@ -226,3 +226,21 @@ def test_pull_rejects_unknown_mode():
 
     with pytest.raises(ValueError):
         run_pushpull_sim(g, sched, 4, mode="push")
+
+
+def test_pull_credit_bound_guard():
+    """Pull mode rejects configs where one hub's per-round responder credit
+    could wrap the uint32 scatter accumulator (degree x chunk >= 2^32)."""
+    from unittest import mock
+
+    import pytest
+
+    from p2p_gossip_tpu.models import protocols as P
+
+    g = pg.erdos_renyi(20, 0.3, seed=0)
+    sched = single_share_schedule(g.n, origin=0)
+    with mock.patch.object(type(g), "max_degree", property(lambda self: 1 << 20)):
+        with pytest.raises(ValueError, match="uint32"):
+            P.run_pushpull_sim(g, sched, 4, mode="pull", chunk_size=4096)
+    # Normal graphs pass the guard.
+    P._check_pull_credit_bound(g, 4096, sched)
