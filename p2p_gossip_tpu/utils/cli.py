@@ -176,6 +176,17 @@ def build_parser() -> argparse.ArgumentParser:
         "'Engine.Event=debug:Engine.Sync=info' or '*=info' "
         "(also honors the P2P_LOG environment variable)",
     )
+    p.add_argument(
+        "--graphFile", type=str, default="",
+        help="npz graph cache: load the topology from this file if it "
+        "exists, else build per --topology and save it — graph builds "
+        "dominate startup at million-node scale",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="Emit one machine-readable JSON line with config, totals, "
+        "and wall time after the reference-format report",
+    )
     return p
 
 
@@ -313,8 +324,59 @@ def run(argv=None) -> int:
     p2plog.set_time_resolution(tick_dt)
     horizon = int(round(args.simTime / tick_dt))
 
+    # Fingerprint of every flag that determines the built topology: a cache
+    # hit with different parameters is an error, not a silent reuse (same
+    # protection the checkpoints get).
+    from p2p_gossip_tpu.utils.checkpoint import fingerprint as _fp
+
+    graph_fp = _fp(
+        "topology", args.topology, args.numNodes, args.connectionProb,
+        args.seed, args.baM, args.wsK, args.wsBeta, args.gridCols,
+        args.graphBuilder,
+    )
+    loaded_graph = None
+    if args.graphFile:
+        import os
+
+        if os.path.exists(args.graphFile):
+            from p2p_gossip_tpu.models.topology import Graph
+
+            try:
+                d = np.load(args.graphFile)
+                cached_fp = str(d["fp"]) if "fp" in d else None
+                loaded_graph = Graph(
+                    n=int(d["n"]), indptr=d["indptr"], indices=d["indices"]
+                )
+            except Exception as e:
+                print(
+                    f"error: --graphFile {args.graphFile} is not a readable "
+                    f"graph cache ({type(e).__name__}: {e}); delete it to "
+                    "rebuild",
+                    file=sys.stderr,
+                )
+                return 2
+            if cached_fp is not None and cached_fp != graph_fp:
+                print(
+                    f"error: --graphFile {args.graphFile} was built with "
+                    "different topology parameters; delete it or match the "
+                    "original flags",
+                    file=sys.stderr,
+                )
+                return 2
+            if loaded_graph.n != args.numNodes:
+                print(
+                    f"error: --graphFile holds a {loaded_graph.n}-node graph, "
+                    f"--numNodes is {args.numNodes}",
+                    file=sys.stderr,
+                )
+                return 2
+
     use_native_builder = False
-    if args.graphBuilder != "python" and args.topology in ("er", "ba"):
+    if (
+        loaded_graph is None
+        and args.graphBuilder != "python"
+        and args.topology in ("er", "ba")
+    ):
         from p2p_gossip_tpu.runtime import native as native_rt
 
         use_native_builder = native_rt.available()
@@ -333,7 +395,9 @@ def run(argv=None) -> int:
         )
         return 2
 
-    if args.topology == "er":
+    if loaded_graph is not None:
+        g = loaded_graph
+    elif args.topology == "er":
         g = (
             native_rt.native_erdos_renyi(
                 args.numNodes, args.connectionProb, seed=args.seed
@@ -377,6 +441,16 @@ def run(argv=None) -> int:
         g = topo.complete_graph(args.numNodes)
     else:
         g = topo.ring_graph(args.numNodes)
+
+    if args.graphFile and loaded_graph is None:
+        import os
+
+        # Atomic write (tmp + replace): an interrupt mid-save must not
+        # leave a torn cache every later run trips over. The tmp name ends
+        # in .npz so np.savez doesn't append its own suffix.
+        tmp = f"{args.graphFile}.{os.getpid()}.tmp.npz"
+        np.savez(tmp, n=g.n, indptr=g.indptr, indices=g.indices, fp=graph_fp)
+        os.replace(tmp, args.graphFile)
 
     if args.genModel == "uniform":
         sched = uniform_renewal_schedule(
@@ -439,11 +513,14 @@ def run(argv=None) -> int:
             seed=args.seed + 7919,
         )
 
-    builder_note = (
-        f", graph-builder={'native' if use_native_builder else 'python'}"
-        if args.topology in ("er", "ba")
-        else ""
-    )
+    if loaded_graph is not None:
+        builder_note = ", graph-builder=cache"
+    elif args.topology in ("er", "ba"):
+        builder_note = (
+            f", graph-builder={'native' if use_native_builder else 'python'}"
+        )
+    else:
+        builder_note = ""
     print(
         f"Starting gossip network simulation: {g.n} nodes, "
         f"{g.num_edges} links, {sched.num_shares} shares scheduled, "
@@ -470,6 +547,13 @@ def run(argv=None) -> int:
         return 2
 
     if args.floodCoverage:
+        if args.json:
+            print(
+                "error: --json is not supported with --floodCoverage (its "
+                "report has its own format)",
+                file=sys.stderr,
+            )
+            return 2
         if args.floodCoverage < 0:
             print(
                 f"error: --floodCoverage must be positive, got "
@@ -621,11 +705,36 @@ def run(argv=None) -> int:
             f"Total socket connections: {snap['connections']}"
         )
     per_node = args.perNodeStats if args.perNodeStats is not None else g.n <= 1000
+    totals = stats.totals()
     print(format_final_statistics(stats, per_node=per_node), end="")
     print(
         f"Simulated {args.simTime:g}s ({horizon} ticks) in {wall:.3f}s wall "
-        f"({stats.totals()['processed'] / max(wall, 1e-9):.3g} node-updates/s)"
+        f"({totals['processed'] / max(wall, 1e-9):.3g} node-updates/s)"
     )
+    if args.json:
+        import json
+
+        print(
+            json.dumps(
+                {
+                    "config": {
+                        "numNodes": g.n,
+                        "edges": int(g.num_edges),
+                        "topology": args.topology,
+                        "protocol": args.protocol,
+                        "backend": args.backend,
+                        "simTime": args.simTime,
+                        "Latency": args.Latency,
+                        "seed": args.seed,
+                    },
+                    "totals": totals,
+                    "wall_s": round(wall, 4),
+                    "node_updates_per_s": round(
+                        totals["processed"] / max(wall, 1e-9), 1
+                    ),
+                }
+            )
+        )
 
     if args.anim:
         from p2p_gossip_tpu.utils.anim import write_animation_xml

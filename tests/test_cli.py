@@ -209,3 +209,60 @@ def test_partnered_event_backend_rejects_lognormal(capsys):
         "--delayModel", "lognormal",
     ])
     assert rc == 2
+
+
+def test_graph_file_cache_and_json(tmp_path, capsys):
+    """--graphFile saves the built topology and reloads it on the next run
+    (identical counters); --json appends a machine-readable summary."""
+    import json
+
+    from p2p_gossip_tpu.utils.cli import run
+
+    gf = str(tmp_path / "g.npz")
+    common = [
+        "--numNodes", "30", "--connectionProb", "0.2", "--simTime", "5",
+        "--Latency", "5", "--seed", "2", "--backend", "event",
+        "--graphFile", gf, "--json",
+    ]
+    assert run(common) == 0
+    first = capsys.readouterr().out
+    assert run(common) == 0  # second run loads the cache
+    second = capsys.readouterr().out
+    assert [l for l in first.splitlines() if l.startswith("Total ")] == [
+        l for l in second.splitlines() if l.startswith("Total ")
+    ]
+    payload = json.loads(second.splitlines()[-1])
+    assert payload["config"]["numNodes"] == 30
+    assert payload["totals"]["received"] == payload["totals"]["forwarded"]
+
+    # Mismatched --numNodes against the cached graph fails cleanly.
+    rc = run([
+        "--numNodes", "31", "--backend", "event", "--graphFile", gf,
+    ])
+    assert rc == 2
+
+
+def test_graph_file_rejects_mismatched_parameters(tmp_path, capsys):
+    from p2p_gossip_tpu.utils.cli import run
+
+    gf = str(tmp_path / "g.npz")
+    base = ["--numNodes", "30", "--simTime", "1", "--backend", "event",
+            "--graphFile", gf]
+    assert run(base + ["--topology", "er", "--seed", "2"]) == 0
+    capsys.readouterr()
+    rc = run(base + ["--topology", "ring", "--seed", "2"])
+    assert rc == 2
+    assert "different topology parameters" in capsys.readouterr().err
+    # Corrupt cache fails cleanly too.
+    with open(gf, "wb") as f:
+        f.write(b"not a zip")
+    rc = run(base + ["--topology", "er", "--seed", "2"])
+    assert rc == 2
+    assert "not a readable graph cache" in capsys.readouterr().err
+
+
+def test_json_rejected_with_flood_coverage(capsys):
+    from p2p_gossip_tpu.utils.cli import run
+
+    rc = run(["--numNodes", "20", "--floodCoverage", "4", "--json"])
+    assert rc == 2
