@@ -387,7 +387,8 @@ def run(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
-    elif args.graphBuilder == "native":
+    elif args.graphBuilder == "native" and loaded_graph is None:
+        # A warm --graphFile cache needs no builder at all.
         print(
             f"error: --graphBuilder native has no {args.topology} builder "
             "(only er/ba)",

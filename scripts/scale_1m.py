@@ -60,6 +60,18 @@ def main() -> None:
         "--cache", type=str, default="",
         help="npz path to cache the built graph across runs",
     )
+    ap.add_argument(
+        "--topology", choices=("er", "ba"), default="er",
+        help="er = the north-star ER config; ba = BASELINE config 4's "
+        "Barabasi-Albert scale-free topology (--baM edges per node)",
+    )
+    ap.add_argument("--baM", type=int, default=3)
+    ap.add_argument(
+        "--mesh", type=str, default="",
+        help="SxN (share-shards x node-shards): run the shard_map sharded "
+        "engine over a device mesh instead of the single-device engine — "
+        "the BASELINE v5e-8 configuration when 8 chips are attached",
+    )
     args = ap.parse_args()
 
     import jax
@@ -83,27 +95,70 @@ def main() -> None:
     # backends" iff devices() first fires after the 4 GB npz load).
     devices = jax.devices()
 
+    # Cache fingerprint: reusing a graph built for different flags would
+    # attribute the benchmark to the wrong topology (same protection the
+    # CLI's --graphFile has). Pre-fingerprint caches (no fp key) load with
+    # a warning for back-compat with earlier runs.
+    from p2p_gossip_tpu.utils.checkpoint import fingerprint as _fp
+
+    graph_fp = _fp(
+        "scale_1m", args.topology, args.nodes, args.prob, args.baM, args.seed
+    )
+
+    def save_cache(graph):
+        # Atomic tmp + replace: a multi-GB savez interrupted mid-write must
+        # not leave a torn cache (tmp name ends in .npz so savez doesn't
+        # append its own suffix).
+        tmp = f"{args.cache}.{os.getpid()}.tmp.npz"
+        np.savez(tmp, n=graph.n, indptr=graph.indptr,
+                 indices=graph.indices, fp=graph_fp)
+        os.replace(tmp, args.cache)
+
     t0 = time.perf_counter()
     if args.cache and os.path.exists(args.cache):
         d = np.load(args.cache)
+        if "fp" not in d:
+            log(f"WARNING: {args.cache} predates cache fingerprints — "
+                "assuming it matches the requested topology flags")
+        elif str(d["fp"]) != graph_fp:
+            log(f"error: {args.cache} was built with different topology "
+                "flags; delete it or match the original arguments")
+            sys.exit(2)
         graph = Graph(n=int(d["n"]), indptr=d["indptr"], indices=d["indices"])
         log(f"graph loaded from {args.cache}: {time.perf_counter()-t0:.1f}s")
+    elif args.topology == "ba":
+        graph = native.native_barabasi_albert(
+            args.nodes, m=args.baM, seed=args.seed
+        )
+        if graph is None:
+            graph = pg.barabasi_albert(args.nodes, m=args.baM, seed=args.seed)
+        log(f"BA graph built: {time.perf_counter()-t0:.1f}s")
+        if args.cache:
+            save_cache(graph)
     else:
         graph = native.native_erdos_renyi(args.nodes, args.prob, seed=args.seed)
         if graph is None:
             graph = pg.erdos_renyi(args.nodes, args.prob, seed=args.seed)
         log(f"graph built: {time.perf_counter()-t0:.1f}s")
         if args.cache:
-            np.savez(args.cache, n=graph.n, indptr=graph.indptr,
-                     indices=graph.indices)
+            save_cache(graph)
     log(
         f"N={graph.n} edges={graph.num_edges} dmax={graph.max_degree} "
         f"devices={devices}"
     )
 
-    t0 = time.perf_counter()
-    dg = DeviceGraph.build(graph)
-    log(f"device staging: {time.perf_counter()-t0:.1f}s")
+    mesh = None
+    if args.mesh:
+        from p2p_gossip_tpu.parallel.mesh import make_mesh
+
+        shares_shards, node_shards = (int(x) for x in args.mesh.split("x"))
+        mesh = make_mesh(node_shards, shares_shards)
+        log(f"mesh: {shares_shards} share-shards x {node_shards} node-shards")
+        dg = None
+    else:
+        t0 = time.perf_counter()
+        dg = DeviceGraph.build(graph)
+        log(f"device staging: {time.perf_counter()-t0:.1f}s")
 
     rng = np.random.default_rng(args.seed)
     origins = rng.integers(0, graph.n, args.shares).astype(np.int32)
@@ -114,10 +169,20 @@ def main() -> None:
         processed = 0
         covs = []
         for lo in range(0, args.shares, chunk):
-            stats, cov = run_flood_coverage(
-                graph, origins[lo : lo + chunk], args.horizon,
-                device_graph=dg, block=args.block,
-            )
+            if mesh is not None:
+                from p2p_gossip_tpu.parallel.engine_sharded import (
+                    run_sharded_flood_coverage,
+                )
+
+                stats, cov = run_sharded_flood_coverage(
+                    graph, origins[lo : lo + chunk], args.horizon, mesh,
+                    block=args.block,
+                )
+            else:
+                stats, cov = run_flood_coverage(
+                    graph, origins[lo : lo + chunk], args.horizon,
+                    device_graph=dg, block=args.block,
+                )
             processed += stats.totals()["processed"]
             covs.append(cov)
         return processed, np.concatenate(covs, axis=1)
@@ -142,8 +207,15 @@ def main() -> None:
         json.dumps(
             {
                 "metric": f"wall seconds to 99% coverage, {args.shares} "
-                f"shares on a {graph.n}-node p={args.prob:g} graph "
-                "(single chip)",
+                f"shares on a {graph.n}-node "
+                + (
+                    f"BA(m={args.baM}) graph"
+                    if args.topology == "ba"
+                    else f"p={args.prob:g} graph"
+                )
+                + (
+                    f" ({args.mesh} mesh)" if args.mesh else " (single chip)"
+                ),
                 "value": round(wall, 2),
                 "unit": "s",
                 "vs_baseline": round(60.0 / wall, 2),
