@@ -21,9 +21,7 @@ def constant_delays(graph: Graph, ticks: int = 1) -> np.ndarray:
     """Every edge has the same integer-tick delay (reference default)."""
     if ticks < 1:
         raise ValueError("delays must be >= 1 tick")
-    deg = graph.degree
-    dmax = int(deg.max()) if graph.n else 0
-    return np.full((graph.n, dmax), ticks, dtype=np.int32)
+    return np.full((graph.n, graph.ell_width), ticks, dtype=np.int32)
 
 
 def _symmetrize_edge_values(graph: Graph, undirected_vals: np.ndarray) -> np.ndarray:
@@ -38,8 +36,7 @@ def _symmetrize_edge_values(graph: Graph, undirected_vals: np.ndarray) -> np.nda
     cols = graph.indices.astype(np.int64)
     keys = np.minimum(rows, cols) * n + np.maximum(rows, cols)
     vals = np.asarray(undirected_vals)[np.searchsorted(edge_keys, keys)]
-    dmax = int(graph.max_degree) if n else 0
-    out = np.ones((n, dmax), dtype=np.int32)
+    out = np.ones((n, graph.ell_width), dtype=np.int32)
     out[rows, pos] = vals
     return out
 

@@ -62,6 +62,15 @@ class Graph:
     def max_degree(self) -> int:
         return int(self.degree.max()) if self.n else 0
 
+    @property
+    def ell_width(self) -> int:
+        """The (n, dmax) ELL minor dimension — the single source of truth
+        shared by `ell()` and the delay builders (models/latency.py), so
+        mask and delay arrays always align. Minimum 1: a zero-width ELL
+        (edgeless graph) breaks downstream gathers at trace time, and one
+        all-masked column is harmless."""
+        return max(self.max_degree, 1)
+
     def csr_rows_pos(self) -> tuple[np.ndarray, np.ndarray]:
         """(rows, pos): for each CSR entry, its row id and its position within
         the row — the coordinate map between CSR and ELL layouts. Single
@@ -81,8 +90,7 @@ class Graph:
         layout: the per-tick frontier propagation is a dense gather over
         ``ell_idx`` plus an OR-reduce along the degree axis.
         """
-        deg = self.degree
-        dmax = int(pad_to if pad_to is not None else (deg.max() if self.n else 0))
+        dmax = int(pad_to) if pad_to is not None else self.ell_width
         ell_idx = np.zeros((self.n, dmax), dtype=np.int32)
         ell_mask = np.zeros((self.n, dmax), dtype=bool)
         rows, pos = self.csr_rows_pos()
@@ -313,3 +321,35 @@ def grid_graph(rows: int, cols: int, torus: bool = False) -> Graph:
         if rows > 2:
             edges.append(np.stack([ids[-1, :].ravel(), ids[0, :].ravel()], axis=1))
     return Graph.from_edges(n, np.concatenate(edges, axis=0))
+
+
+def save_graph_cache(path: str, graph: Graph, fp: str = "") -> None:
+    """Atomic npz graph cache write (tmp + fsync + replace — a multi-GB
+    save interrupted mid-write must not leave a torn cache). ``fp`` is the
+    caller's build-parameter fingerprint, verified on load."""
+    import os
+
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(
+            f, n=graph.n, indptr=graph.indptr, indices=graph.indices, fp=fp
+        )
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_graph_cache(path: str) -> tuple[Graph, str | None]:
+    """Load an npz graph cache -> (graph, fingerprint-or-None). Raises
+    ValueError with a human-readable message on an unreadable or
+    non-graph file (callers turn it into their clean-error convention)."""
+    try:
+        d = np.load(path)
+        fp = str(d["fp"]) if "fp" in d else None
+        graph = Graph(n=int(d["n"]), indptr=d["indptr"], indices=d["indices"])
+    except Exception as e:
+        raise ValueError(
+            f"{path} is not a readable graph cache "
+            f"({type(e).__name__}: {e}); delete it to rebuild"
+        ) from e
+    return graph, fp

@@ -339,21 +339,12 @@ def run(argv=None) -> int:
         import os
 
         if os.path.exists(args.graphFile):
-            from p2p_gossip_tpu.models.topology import Graph
+            from p2p_gossip_tpu.models.topology import load_graph_cache
 
             try:
-                d = np.load(args.graphFile)
-                cached_fp = str(d["fp"]) if "fp" in d else None
-                loaded_graph = Graph(
-                    n=int(d["n"]), indptr=d["indptr"], indices=d["indices"]
-                )
-            except Exception as e:
-                print(
-                    f"error: --graphFile {args.graphFile} is not a readable "
-                    f"graph cache ({type(e).__name__}: {e}); delete it to "
-                    "rebuild",
-                    file=sys.stderr,
-                )
+                loaded_graph, cached_fp = load_graph_cache(args.graphFile)
+            except ValueError as e:
+                print(f"error: --graphFile {e}", file=sys.stderr)
                 return 2
             if cached_fp is not None and cached_fp != graph_fp:
                 print(
@@ -444,14 +435,9 @@ def run(argv=None) -> int:
         g = topo.ring_graph(args.numNodes)
 
     if args.graphFile and loaded_graph is None:
-        import os
+        from p2p_gossip_tpu.models.topology import save_graph_cache
 
-        # Atomic write (tmp + replace): an interrupt mid-save must not
-        # leave a torn cache every later run trips over. The tmp name ends
-        # in .npz so np.savez doesn't append its own suffix.
-        tmp = f"{args.graphFile}.{os.getpid()}.tmp.npz"
-        np.savez(tmp, n=g.n, indptr=g.indptr, indices=g.indices, fp=graph_fp)
-        os.replace(tmp, args.graphFile)
+        save_graph_cache(args.graphFile, g, fp=graph_fp)
 
     if args.genModel == "uniform":
         sched = uniform_renewal_schedule(

@@ -77,7 +77,6 @@ def main() -> None:
     import jax
 
     import p2p_gossip_tpu as pg
-    from p2p_gossip_tpu.models.topology import Graph
     from p2p_gossip_tpu.engine.sync import (
         DeviceGraph, run_flood_coverage, time_to_coverage,
     )
@@ -99,6 +98,10 @@ def main() -> None:
     # attribute the benchmark to the wrong topology (same protection the
     # CLI's --graphFile has). Pre-fingerprint caches (no fp key) load with
     # a warning for back-compat with earlier runs.
+    from p2p_gossip_tpu.models.topology import (
+        load_graph_cache,
+        save_graph_cache,
+    )
     from p2p_gossip_tpu.utils.checkpoint import fingerprint as _fp
 
     graph_fp = _fp(
@@ -106,25 +109,22 @@ def main() -> None:
     )
 
     def save_cache(graph):
-        # Atomic tmp + replace: a multi-GB savez interrupted mid-write must
-        # not leave a torn cache (tmp name ends in .npz so savez doesn't
-        # append its own suffix).
-        tmp = f"{args.cache}.{os.getpid()}.tmp.npz"
-        np.savez(tmp, n=graph.n, indptr=graph.indptr,
-                 indices=graph.indices, fp=graph_fp)
-        os.replace(tmp, args.cache)
+        save_graph_cache(args.cache, graph, fp=graph_fp)
 
     t0 = time.perf_counter()
     if args.cache and os.path.exists(args.cache):
-        d = np.load(args.cache)
-        if "fp" not in d:
+        try:
+            graph, cached_fp = load_graph_cache(args.cache)
+        except ValueError as e:
+            log(f"error: --cache {e}")
+            sys.exit(2)
+        if cached_fp is None:
             log(f"WARNING: {args.cache} predates cache fingerprints — "
                 "assuming it matches the requested topology flags")
-        elif str(d["fp"]) != graph_fp:
+        elif cached_fp != graph_fp:
             log(f"error: {args.cache} was built with different topology "
                 "flags; delete it or match the original arguments")
             sys.exit(2)
-        graph = Graph(n=int(d["n"]), indptr=d["indptr"], indices=d["indices"])
         log(f"graph loaded from {args.cache}: {time.perf_counter()-t0:.1f}s")
     elif args.topology == "ba":
         graph = native.native_barabasi_albert(

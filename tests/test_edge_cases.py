@@ -1,0 +1,101 @@
+"""Boundary conditions every engine must survive identically: empty
+schedules, zero horizons, minimal graphs, and generations at the horizon
+boundary (the reference crashes on numNodes=1, so two nodes is the floor)."""
+
+import numpy as np
+import pytest
+
+import p2p_gossip_tpu as pg
+from p2p_gossip_tpu.engine.event import run_event_sim
+from p2p_gossip_tpu.engine.sync import run_sync_sim
+from p2p_gossip_tpu.models.generation import Schedule
+from p2p_gossip_tpu.models.protocols import run_pushk_sim, run_pushpull_sim
+from p2p_gossip_tpu.runtime import native
+
+
+def _two_nodes():
+    return pg.ring_graph(3)  # smallest ring; degree 2 each
+
+
+def _empty_sched(n):
+    return Schedule(
+        n, np.zeros(0, dtype=np.int32), np.zeros(0, dtype=np.int32)
+    )
+
+
+def test_empty_schedule_all_engines():
+    g = _two_nodes()
+    sched = _empty_sched(g.n)
+    for run in (run_event_sim, run_sync_sim):
+        stats = run(g, sched, 10)
+        assert stats.totals()["processed"] == 0
+        assert stats.totals()["sent"] == 0
+        stats.check_conservation()
+    if native.available():
+        stats = native.run_native_sim(g, sched, 10)
+        assert stats.totals()["processed"] == 0
+    for run_p in (run_pushpull_sim, run_pushk_sim):
+        stats, _ = run_p(g, sched, 10, seed=1)
+        assert stats.totals()["processed"] == 0
+
+
+def test_zero_horizon_all_engines():
+    g = _two_nodes()
+    sched = Schedule(
+        g.n, np.array([0], dtype=np.int32), np.array([0], dtype=np.int32)
+    )
+    # Nothing fires at tick >= horizon (Simulator::Stop semantics).
+    for run in (run_event_sim, run_sync_sim):
+        stats = run(g, sched, 0)
+        assert stats.totals()["processed"] == 0
+    stats, _ = run_pushpull_sim(g, sched, 0, seed=1)
+    assert stats.totals()["processed"] == 0
+
+
+def test_generation_at_horizon_boundary():
+    """A share whose gen tick equals the horizon never fires; one tick
+    earlier it generates but its broadcasts can't land."""
+    g = _two_nodes()
+    at_h = Schedule(
+        g.n, np.array([0], dtype=np.int32), np.array([5], dtype=np.int32)
+    )
+    for run in (run_event_sim, run_sync_sim):
+        assert run(g, at_h, 5).totals()["generated"] == 0
+        stats = run(g, at_h, 6)
+        assert stats.totals()["generated"] == 1
+        assert stats.totals()["received"] == 0  # arrivals land at tick 6
+        assert stats.totals()["sent"] == 2
+
+
+def test_minimal_graph_flood_parity():
+    g = pg.complete_graph(2)
+    sched = Schedule(
+        g.n, np.array([0, 1], dtype=np.int32), np.array([0, 2], dtype=np.int32)
+    )
+    ev = run_event_sim(g, sched, 10)
+    sy = run_sync_sim(g, sched, 10)
+    assert ev.equal_counts(sy)
+    assert ev.totals()["processed"] == 4  # both shares reach both nodes
+    if native.available():
+        assert native.run_native_sim(g, sched, 10).equal_counts(ev)
+
+
+def test_single_node_degenerate_graph():
+    """The reference crashes on numNodes=1 (no valid forced edge); we
+    produce the degenerate one-node graph and every engine handles it:
+    the node generates, sends to its zero peers, and exchanges nothing
+    (Graph.validate() still rejects it as violating the reference's
+    connectivity guarantee)."""
+    g = pg.erdos_renyi(1, 0.3, seed=0)
+    assert g.n == 1 and g.num_edges == 0
+    with pytest.raises(AssertionError):
+        g.validate()
+    sched = Schedule(
+        g.n, np.array([0], dtype=np.int32), np.array([0], dtype=np.int32)
+    )
+    for run in (run_event_sim, run_sync_sim):
+        stats = run(g, sched, 5)
+        t = stats.totals()
+        assert t["generated"] == 1 and t["sent"] == 0 and t["received"] == 0
+    stats, _ = run_pushpull_sim(g, sched, 5, seed=1)
+    assert stats.totals() == t
