@@ -26,25 +26,47 @@ def log(msg: str) -> None:
 
 
 def main() -> None:
+    # A wedged TPU tunnel hangs in-process backend init; wait it out with
+    # killable subprocess probes rather than losing the benchmark run. If
+    # the tunnel never answers, fall back to a smaller CPU measurement
+    # with an honest label — a degraded number beats no record at all.
+    from p2p_gossip_tpu.utils.platform import (
+        force_cpu_backend_if_requested,
+        wait_for_device,
+    )
+
+    cpu_fallback = False
+    try:
+        wait_for_device()
+    except Exception as e:
+        log(f"TPU unreachable after retries ({type(e).__name__}); "
+            "falling back to a reduced CPU benchmark")
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        force_cpu_backend_if_requested()
+        cpu_fallback = True
+
     import jax
 
     import p2p_gossip_tpu as pg
     from p2p_gossip_tpu.engine.sync import DeviceGraph, run_sync_sim
     from p2p_gossip_tpu.runtime import native
 
-    n, p, seed = 100_000, 0.001, 0
-    n_shares, gen_window, horizon = 8192, 16, 64
-    # Swept on the real chip (2026-07): 8192-share chunks (W=256 words keeps
-    # the row gather on wide 1KB rows) are the throughput peak — ~1.2x over
-    # 4096; 16384 regresses. The degree block auto-resolves to the swept
-    # TPU optimum (ops/ell.py TUNED_TPU_BLOCK).
-    chunk_size = 8192
+    if cpu_fallback:
+        # The full 100K x 8192 config takes far too long on host CPU.
+        n, p, seed = 20_000, 0.001, 0
+        n_shares, gen_window, horizon = 1024, 16, 64
+        chunk_size = 1024
+    else:
+        n, p, seed = 100_000, 0.001, 0
+        n_shares, gen_window, horizon = 8192, 16, 64
+        # Swept on the real chip (2026-07): 8192-share chunks (W=256 words
+        # keeps the row gather on wide 1KB rows) are the throughput peak —
+        # ~1.2x over 4096; 16384 regresses. The degree block auto-resolves
+        # to the swept TPU optimum (ops/ell.py TUNED_TPU_BLOCK).
+        chunk_size = 8192
 
-    # A wedged TPU tunnel hangs in-process backend init; wait it out with
-    # killable subprocess probes rather than losing the benchmark run.
-    from p2p_gossip_tpu.utils.platform import wait_for_device
-
-    wait_for_device()
     log(f"devices: {jax.devices()}")
     t0 = time.perf_counter()
     graph = native.native_erdos_renyi(n, p, seed=seed)
@@ -102,8 +124,13 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "node-updates/sec (100K-node p=0.001 gossip flood, "
-                "single chip)",
+                "metric": (
+                    f"node-updates/sec ({n // 1000}K-node p={p:g} gossip "
+                    "flood, CPU FALLBACK - TPU tunnel down)"
+                    if cpu_fallback
+                    else "node-updates/sec (100K-node p=0.001 gossip flood, "
+                    "single chip)"
+                ),
                 "value": round(tpu_rate, 1),
                 "unit": "node-updates/s",
                 "vs_baseline": round(tpu_rate / base_rate, 2),

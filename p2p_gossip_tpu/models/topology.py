@@ -324,19 +324,14 @@ def grid_graph(rows: int, cols: int, torus: bool = False) -> Graph:
 
 
 def save_graph_cache(path: str, graph: Graph, fp: str = "") -> None:
-    """Atomic npz graph cache write (tmp + fsync + replace — a multi-GB
-    save interrupted mid-write must not leave a torn cache). ``fp`` is the
-    caller's build-parameter fingerprint, verified on load."""
-    import os
+    """Atomic npz graph cache write (shared atomic_savez: tmp + fsync +
+    replace, tmp removed on failure). ``fp`` is the caller's
+    build-parameter fingerprint, verified on load."""
+    from p2p_gossip_tpu.utils.checkpoint import atomic_savez
 
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "wb") as f:
-        np.savez(
-            f, n=graph.n, indptr=graph.indptr, indices=graph.indices, fp=fp
-        )
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    atomic_savez(
+        path, n=graph.n, indptr=graph.indptr, indices=graph.indices, fp=fp
+    )
 
 
 def load_graph_cache(path: str) -> tuple[Graph, str | None]:
@@ -344,9 +339,11 @@ def load_graph_cache(path: str) -> tuple[Graph, str | None]:
     ValueError with a human-readable message on an unreadable or
     non-graph file (callers turn it into their clean-error convention)."""
     try:
-        d = np.load(path)
-        fp = str(d["fp"]) if "fp" in d else None
-        graph = Graph(n=int(d["n"]), indptr=d["indptr"], indices=d["indices"])
+        with np.load(path) as d:
+            fp = str(d["fp"]) if "fp" in d else None
+            graph = Graph(
+                n=int(d["n"]), indptr=d["indptr"], indices=d["indices"]
+            )
     except Exception as e:
         raise ValueError(
             f"{path} is not a readable graph cache "

@@ -49,19 +49,34 @@ def fingerprint(*parts) -> str:
     return h.hexdigest()
 
 
+def atomic_savez(path: str, **arrays) -> None:
+    """Atomic npz write: tmp + fsync + os.replace, tmp removed on failure.
+    The one implementation behind checkpoints and graph caches — a
+    multi-GB save interrupted mid-write must never leave a torn file the
+    next run trips over, nor litter partial tmp files on ENOSPC."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def save_checkpoint(path: str, arrays: dict[str, np.ndarray], meta: dict) -> None:
     """Atomically write ``arrays`` + ``meta`` to ``path`` (.npz)."""
     meta = dict(meta, format_version=_FORMAT_VERSION)
-    tmp = f"{path}.tmp"
-    with open(tmp, "wb") as f:
-        np.savez(
-            f,
-            **arrays,
-            **{_META_KEY: np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)},
-        )
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    atomic_savez(
+        path,
+        **arrays,
+        **{_META_KEY: np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)},
+    )
     log.debug(f"saved checkpoint to {path}: {meta}")
 
 

@@ -1,6 +1,6 @@
 """Boundary conditions every engine must survive identically: empty
 schedules, zero horizons, minimal graphs, and generations at the horizon
-boundary (the reference crashes on numNodes=1, so two nodes is the floor)."""
+boundary (the reference crashes on numNodes=1)."""
 
 import numpy as np
 import pytest
@@ -13,7 +13,7 @@ from p2p_gossip_tpu.models.protocols import run_pushk_sim, run_pushpull_sim
 from p2p_gossip_tpu.runtime import native
 
 
-def _two_nodes():
+def _ring3():
     return pg.ring_graph(3)  # smallest ring; degree 2 each
 
 
@@ -24,7 +24,7 @@ def _empty_sched(n):
 
 
 def test_empty_schedule_all_engines():
-    g = _two_nodes()
+    g = _ring3()
     sched = _empty_sched(g.n)
     for run in (run_event_sim, run_sync_sim):
         stats = run(g, sched, 10)
@@ -40,7 +40,7 @@ def test_empty_schedule_all_engines():
 
 
 def test_zero_horizon_all_engines():
-    g = _two_nodes()
+    g = _ring3()
     sched = Schedule(
         g.n, np.array([0], dtype=np.int32), np.array([0], dtype=np.int32)
     )
@@ -55,7 +55,7 @@ def test_zero_horizon_all_engines():
 def test_generation_at_horizon_boundary():
     """A share whose gen tick equals the horizon never fires; one tick
     earlier it generates but its broadcasts can't land."""
-    g = _two_nodes()
+    g = _ring3()
     at_h = Schedule(
         g.n, np.array([0], dtype=np.int32), np.array([5], dtype=np.int32)
     )
