@@ -31,11 +31,16 @@ def main() -> None:
     # the tunnel never answers, fall back to a smaller CPU measurement
     # with an honest label — a degraded number beats no record at all.
     from p2p_gossip_tpu.utils.platform import (
+        cpu_requested,
         force_cpu_backend_if_requested,
         wait_for_device,
     )
 
-    cpu_fallback = False
+    # Any CPU execution — explicit JAX_PLATFORMS=cpu or the tunnel-down
+    # fallback — runs the reduced config under an honest CPU label; the
+    # full 100K x 8192 config takes far too long on host CPU.
+    cpu_fallback = cpu_requested()
+    cpu_reason = "JAX_PLATFORMS=cpu" if cpu_fallback else ""
     try:
         wait_for_device()
     except Exception as e:
@@ -46,6 +51,7 @@ def main() -> None:
         os.environ["JAX_PLATFORMS"] = "cpu"
         force_cpu_backend_if_requested()
         cpu_fallback = True
+        cpu_reason = "TPU tunnel down"
 
     import jax
 
@@ -54,7 +60,6 @@ def main() -> None:
     from p2p_gossip_tpu.runtime import native
 
     if cpu_fallback:
-        # The full 100K x 8192 config takes far too long on host CPU.
         n, p, seed = 20_000, 0.001, 0
         n_shares, gen_window, horizon = 1024, 16, 64
         chunk_size = 1024
@@ -126,7 +131,7 @@ def main() -> None:
             {
                 "metric": (
                     f"node-updates/sec ({n // 1000}K-node p={p:g} gossip "
-                    "flood, CPU FALLBACK - TPU tunnel down)"
+                    f"flood, CPU - {cpu_reason})"
                     if cpu_fallback
                     else "node-updates/sec (100K-node p=0.001 gossip flood, "
                     "single chip)"

@@ -201,7 +201,6 @@ def build_partnered_runner(
                 pick_cnt = bitmask.popcount_rows(
                     my_old.reshape(n_loc * k, w)
                 ).reshape(n_loc, k)
-                remote = jnp.uint32(0)
                 sent_add = jnp.sum(jnp.where(attempted, pick_cnt, 0), axis=1)
 
             sent_lo, sent_hi = bitmask.add_u64(sent_lo, sent_hi, sent_add)

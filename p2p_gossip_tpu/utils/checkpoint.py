@@ -53,8 +53,11 @@ def atomic_savez(path: str, **arrays) -> None:
     """Atomic npz write: tmp + fsync + os.replace, tmp removed on failure.
     The one implementation behind checkpoints and graph caches — a
     multi-GB save interrupted mid-write must never leave a torn file the
-    next run trips over, nor litter partial tmp files on ENOSPC."""
-    tmp = f"{path}.{os.getpid()}.tmp"
+    next run trips over, nor litter partial tmp files on ENOSPC. The tmp
+    name is deliberately STABLE (no pid): an orphan left by a hard kill
+    (SIGKILL skips the cleanup) is overwritten and reclaimed by the next
+    run's save instead of accumulating forever."""
+    tmp = f"{path}.tmp"
     try:
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
