@@ -49,15 +49,39 @@ def fingerprint(*parts) -> str:
     return h.hexdigest()
 
 
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
 def atomic_savez(path: str, **arrays) -> None:
-    """Atomic npz write: tmp + fsync + os.replace, tmp removed on failure.
-    The one implementation behind checkpoints and graph caches — a
-    multi-GB save interrupted mid-write must never leave a torn file the
-    next run trips over, nor litter partial tmp files on ENOSPC. The tmp
-    name is deliberately STABLE (no pid): an orphan left by a hard kill
-    (SIGKILL skips the cleanup) is overwritten and reclaimed by the next
-    run's save instead of accumulating forever."""
-    tmp = f"{path}.tmp"
+    """Atomic npz write: pid-unique tmp + fsync + os.replace, tmp removed
+    on failure. The one implementation behind checkpoints and graph
+    caches — a multi-GB save interrupted mid-write must never leave a
+    torn file the next run trips over. The pid in the tmp name keeps
+    concurrent writers to one path from truncating each other's
+    in-flight tmp; orphans from hard-killed writers (SIGKILL skips the
+    cleanup) are reclaimed here by unlinking tmps whose writer pid no
+    longer exists."""
+    import glob
+
+    for old in glob.glob(f"{glob.escape(path)}.*.tmp"):
+        try:
+            pid = int(old.rsplit(".", 2)[-2])
+        except ValueError:
+            continue
+        if pid != os.getpid() and not _pid_alive(pid):
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+
+    tmp = f"{path}.{os.getpid()}.tmp"
     try:
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)

@@ -237,3 +237,24 @@ def test_partnered_checkpoint_rejects_coverage(tmp_path):
             g, sched, 5, checkpoint_path=str(tmp_path / "c.npz"),
             record_coverage=True,
         )
+
+
+def test_atomic_savez_reclaims_dead_writer_tmps(tmp_path):
+    """Orphan tmps from hard-killed writers are swept on the next save;
+    a (simulated) live concurrent writer's tmp is left alone."""
+    import numpy as np
+
+    from p2p_gossip_tpu.utils import checkpoint as C
+
+    path = str(tmp_path / "x.npz")
+    dead = f"{path}.999999999.tmp"   # no such pid
+    live = f"{path}.{__import__('os').getpid()}.live.tmp"  # non-matching name
+    open(dead, "wb").write(b"torn")
+    open(live, "wb").write(b"inflight")
+    C.atomic_savez(path, a=np.arange(3))
+    import os
+
+    assert not os.path.exists(dead)
+    assert os.path.exists(live)      # unparsable pid slot -> untouched
+    with np.load(path) as d:
+        assert list(d["a"]) == [0, 1, 2]

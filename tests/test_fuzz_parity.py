@@ -4,6 +4,8 @@ the native C++ engine, and the sync TPU engine must produce identical
 per-node counters and snapshots. This is the NS-3-stats-parity axis run as
 a property test rather than hand-picked cases."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -60,7 +62,10 @@ def _random_config(seed: int):
     return g, sched, horizon, delays, churn, loss, snaps
 
 
-@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize(
+    # Widen the randomized sweep with P2P_FUZZ_SEEDS=N for soak runs.
+    "seed", range(int(os.environ.get("P2P_FUZZ_SEEDS", "8")))
+)
 def test_three_engine_parity_random_config(seed):
     g, sched, horizon, delays, churn, loss, snaps = _random_config(seed)
     ev = run_event_sim(

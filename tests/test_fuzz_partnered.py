@@ -5,6 +5,8 @@ host-replicated seeded picks), and the shard_map mesh engine must produce
 identical per-node counters. The partnered-protocol analogue of
 test_fuzz_parity.py."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -58,19 +60,24 @@ def _random_config(seed: int):
         if rng.random() < 0.5
         else None
     )
-    protocol = "pushpull" if rng.random() < 0.5 else "pushk"
+    protocol = str(rng.choice(["pushpull", "pull", "pushk"]))
     fanout = int(rng.integers(1, 5))
     shares_shards = int(rng.choice([1, 2, 4]))
     mesh_shape = (shares_shards, 8 // shares_shards)
     return g, sched, horizon, delays, churn, loss, protocol, fanout, mesh_shape
 
 
-@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize(
+    # Widen the randomized sweep with P2P_FUZZ_SEEDS=N for soak runs.
+    "seed", range(int(os.environ.get("P2P_FUZZ_SEEDS", "8")))
+)
 def test_partnered_three_way_parity_random_config(seed):
     (g, sched, horizon, delays, churn, loss, protocol, fanout,
      (shares, nodes)) = _random_config(seed)
-    single_fn = run_pushpull_sim if protocol == "pushpull" else run_pushk_sim
-    kw = {} if protocol == "pushpull" else {"fanout": fanout}
+    if protocol == "pushk":
+        single_fn, kw = run_pushk_sim, {"fanout": fanout}
+    else:
+        single_fn, kw = run_pushpull_sim, {"mode": protocol}
     single, _ = single_fn(
         g, sched, horizon, ell_delays=delays, seed=seed, chunk_size=32,
         churn=churn, loss=loss, **kw,
@@ -83,12 +90,14 @@ def test_partnered_three_way_parity_random_config(seed):
     assert sharded.equal_counts(single), (seed, protocol)
     # The numpy oracle covers the uniform one-tick-delay case only.
     if delays is None:
-        oracle_fn = pushpull_oracle if protocol == "pushpull" else pushk_oracle
-        picks = seeded_partners(
-            g, horizon, seed,
-            fanout=None if protocol == "pushpull" else fanout,
-        )
-        want = oracle_fn(g, sched, horizon, picks, churn=churn, loss=loss)
+        if protocol == "pushk":
+            picks = seeded_partners(g, horizon, seed, fanout=fanout)
+            want = pushk_oracle(g, sched, horizon, picks, churn=churn, loss=loss)
+        else:
+            picks = seeded_partners(g, horizon, seed)
+            want = pushpull_oracle(
+                g, sched, horizon, picks, churn=churn, loss=loss, mode=protocol
+            )
         assert single.equal_counts(want), (seed, protocol)
     # Structural invariants shared by the protocol family.
     assert (single.received == single.forwarded).all()
