@@ -28,8 +28,19 @@ from p2p_gossip_tpu.models.topology import (
     grid_graph,
 )
 from p2p_gossip_tpu.models.generation import uniform_renewal_schedule, poisson_schedule, Schedule
+from p2p_gossip_tpu.models.churn import ChurnModel, from_intervals, random_churn
+from p2p_gossip_tpu.models.latency import constant_delays, lognormal_delays
 from p2p_gossip_tpu.models.linkloss import LinkLossModel
 from p2p_gossip_tpu.utils.stats import NodeStats
+
+# The compute engines stay behind explicit module imports: importing jax
+# is safe (backends init lazily) but the engines' first device use dials
+# the TPU plugin — keeping them out of the root import lets the
+# event/native backends run with no device tunnel at all:
+#   from p2p_gossip_tpu.engine.sync import run_sync_sim
+#   from p2p_gossip_tpu.engine.event import run_event_sim
+#   from p2p_gossip_tpu.models.protocols import run_pushpull_sim, run_pushk_sim
+#   from p2p_gossip_tpu.parallel.engine_sharded import run_sharded_sim
 
 __version__ = "0.1.0"
 
@@ -44,6 +55,11 @@ __all__ = [
     "Schedule",
     "uniform_renewal_schedule",
     "poisson_schedule",
+    "ChurnModel",
+    "from_intervals",
+    "random_churn",
+    "constant_delays",
+    "lognormal_delays",
     "LinkLossModel",
     "NodeStats",
 ]

@@ -80,6 +80,12 @@ def atomic_savez(path: str, **arrays) -> None:
                 os.unlink(old)
             except OSError:
                 pass
+    # Legacy orphan from the earlier stable-name scheme ("<path>.tmp"):
+    # nothing writes that name anymore, so it can only be dead litter.
+    try:
+        os.unlink(f"{path}.tmp")
+    except OSError:
+        pass
 
     tmp = f"{path}.{os.getpid()}.tmp"
     try:

@@ -240,21 +240,27 @@ def test_partnered_checkpoint_rejects_coverage(tmp_path):
 
 
 def test_atomic_savez_reclaims_dead_writer_tmps(tmp_path):
-    """Orphan tmps from hard-killed writers are swept on the next save;
-    a (simulated) live concurrent writer's tmp is left alone."""
+    """Orphan tmps from hard-killed writers (and the legacy stable-name
+    scheme) are swept on the next save; a live concurrent writer's tmp
+    and unparsable names are left alone."""
+    import os
+
     import numpy as np
 
     from p2p_gossip_tpu.utils import checkpoint as C
 
     path = str(tmp_path / "x.npz")
-    dead = f"{path}.999999999.tmp"   # no such pid
-    live = f"{path}.{__import__('os').getpid()}.live.tmp"  # non-matching name
-    open(dead, "wb").write(b"torn")
-    open(live, "wb").write(b"inflight")
+    dead = f"{path}.999999999.tmp"             # no such pid
+    legacy = f"{path}.tmp"                      # pre-pid-scheme orphan
+    live = f"{path}.{os.getppid()}.tmp"         # a genuinely live pid
+    odd = f"{path}.notapid.x.tmp"               # unparsable pid slot
+    for p, content in ((dead, b"torn"), (legacy, b"old"),
+                       (live, b"inflight"), (odd, b"?")):
+        open(p, "wb").write(content)
     C.atomic_savez(path, a=np.arange(3))
-    import os
-
     assert not os.path.exists(dead)
-    assert os.path.exists(live)      # unparsable pid slot -> untouched
+    assert not os.path.exists(legacy)
+    assert os.path.exists(live)   # live writer untouched
+    assert os.path.exists(odd)    # unparsable -> untouched
     with np.load(path) as d:
         assert list(d["a"]) == [0, 1, 2]
