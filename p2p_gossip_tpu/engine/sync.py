@@ -584,8 +584,14 @@ def run_flood_coverage(
     sched = Schedule(graph.n, origins, np.zeros(s, dtype=np.int32))
     o, g = sched.padded(chunk_size, horizon_ticks)
     # Gate on where the graph actually lives (tests pin data to host CPU
-    # even though a TPU plugin is registered).
-    use_pallas = any(d.platform == "tpu" for d in dg.ell_idx.devices())
+    # even though a TPU plugin is registered) and on the kernel's validated
+    # row bound (ops/pallas_kernels.py PALLAS_COVERAGE_MAX_ROWS).
+    from p2p_gossip_tpu.ops.pallas_kernels import coverage_rows_ok
+
+    use_pallas = (
+        any(d.platform == "tpu" for d in dg.ell_idx.devices())
+        and coverage_rows_ok(dg.n)
+    )
     churn_dev = churn_to_device(churn)
     loss_cfg = loss.static_cfg if loss is not None else None
     _, r, snt, cov = _run_chunk_coverage(

@@ -35,6 +35,33 @@ from p2p_gossip_tpu.ops.bitmask import WORD_BITS
 
 DEFAULT_ROW_TILE = 256
 
+# Row bound for using the coverage kernel on real TPU (override with the
+# P2P_PALLAS_COVERAGE_MAX_ROWS env var; 0 disables the kernel). The kernel
+# is validated on-chip to 100K rows; a TPU worker crash observed once at
+# 1M rows is unresolved — the suspect list includes this kernel's ~3900-step
+# revisited-output grid — so anything beyond the validated size defaults to
+# the XLA path until the kernel is exonerated on hardware.
+PALLAS_COVERAGE_MAX_ROWS = 100_000
+
+
+def coverage_rows_ok(n_rows: int) -> bool:
+    """Whether the coverage kernel should be used for ``n_rows`` (see
+    PALLAS_COVERAGE_MAX_ROWS)."""
+    import os
+    import warnings
+
+    raw = os.environ.get("P2P_PALLAS_COVERAGE_MAX_ROWS")
+    limit = PALLAS_COVERAGE_MAX_ROWS
+    if raw is not None:
+        try:
+            limit = int(raw)
+        except ValueError:
+            warnings.warn(
+                f"P2P_PALLAS_COVERAGE_MAX_ROWS={raw!r} is not an integer; "
+                f"using the default {PALLAS_COVERAGE_MAX_ROWS}"
+            )
+    return 0 < n_rows <= limit
+
 
 def _coverage_kernel(seen_ref, acc_ref):
     """Grid: row tiles. seen_ref: (TILE_N, W) uint32 in VMEM. acc_ref:

@@ -55,3 +55,26 @@ def test_popcount_kernel_zero_and_full():
     )
     got = np.asarray(popcount_rows_pallas(words, row_tile=8, interpret=True))
     assert (got[:10] == 0).all() and (got[10:] == 64).all()
+
+
+def test_coverage_rows_gate(monkeypatch):
+    from p2p_gossip_tpu.ops.pallas_kernels import (
+        PALLAS_COVERAGE_MAX_ROWS,
+        coverage_rows_ok,
+    )
+
+    monkeypatch.delenv("P2P_PALLAS_COVERAGE_MAX_ROWS", raising=False)
+    assert coverage_rows_ok(100_000)
+    assert coverage_rows_ok(PALLAS_COVERAGE_MAX_ROWS)
+    assert not coverage_rows_ok(PALLAS_COVERAGE_MAX_ROWS + 1)
+    monkeypatch.setenv("P2P_PALLAS_COVERAGE_MAX_ROWS", "50")
+    assert coverage_rows_ok(50) and not coverage_rows_ok(51)
+    monkeypatch.setenv("P2P_PALLAS_COVERAGE_MAX_ROWS", "0")
+    assert not coverage_rows_ok(10)  # 0 disables the kernel outright
+    monkeypatch.setenv("P2P_PALLAS_COVERAGE_MAX_ROWS", "256k")
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert coverage_rows_ok(100_000)  # bad override -> default + warning
+    assert any("P2P_PALLAS_COVERAGE_MAX_ROWS" in str(x.message) for x in w)
