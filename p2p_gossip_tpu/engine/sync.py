@@ -588,10 +588,13 @@ def run_flood_coverage(
     # row bound (ops/pallas_kernels.py PALLAS_COVERAGE_MAX_ROWS).
     from p2p_gossip_tpu.ops.pallas_kernels import coverage_rows_ok
 
-    use_pallas = (
-        any(d.platform == "tpu" for d in dg.ell_idx.devices())
-        and coverage_rows_ok(dg.n)
-    )
+    on_tpu = any(d.platform == "tpu" for d in dg.ell_idx.devices())
+    use_pallas = on_tpu and coverage_rows_ok(dg.n)
+    if on_tpu and not use_pallas:
+        log.info(
+            f"coverage: Pallas kernel demoted to the XLA path (N={dg.n} "
+            "exceeds PALLAS_COVERAGE_MAX_ROWS)"
+        )
     churn_dev = churn_to_device(churn)
     loss_cfg = loss.static_cfg if loss is not None else None
     _, r, snt, cov = _run_chunk_coverage(
