@@ -83,10 +83,13 @@ def main() -> None:
     from p2p_gossip_tpu.runtime import native
 
     # A wedged TPU tunnel hangs in-process backend init; wait it out with
-    # killable subprocess probes (shared with bench.py).
-    from p2p_gossip_tpu.utils.platform import wait_for_device
+    # killable subprocess probes (shared with bench.py). Unlike bench.py
+    # this script has no CPU fallback — a 1M-node run is TPU-or-nothing —
+    # so use the long-wait budget (P2P_DEVICE_WAIT_S still outranks it
+    # for harness-driven runs).
+    from p2p_gossip_tpu.utils.platform import LONG_DEVICE_WAIT_S, wait_for_device
 
-    wait_for_device()
+    wait_for_device(max_wait_s=LONG_DEVICE_WAIT_S)
 
     # Initialize the TPU backend BEFORE the multi-GB graph load: the axon
     # tunnel plugin fails to register under the memory pressure / delay of

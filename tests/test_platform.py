@@ -1,0 +1,128 @@
+"""wait_for_device budget semantics (utils/platform.py).
+
+The device wait must return control inside a caller-visible wall-clock
+budget — round 1 lost its benchmark artifact because the unbounded wait
+outlived the harness clock and the CPU fallback never fired.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from p2p_gossip_tpu.utils import platform as plat
+
+
+@pytest.fixture
+def tpu_env(monkeypatch):
+    """Pretend the TPU platform was requested (the wait path under test
+    is skipped entirely under JAX_PLATFORMS=cpu, which conftest sets)."""
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    yield monkeypatch
+
+
+def _hang_probe(monkeypatch, calls):
+    """Make every subprocess probe behave like a wedged tunnel."""
+
+    def fake_run(cmd, check, timeout, capture_output):
+        calls.append(timeout)
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    # wait_for_device imports subprocess locally; patch the module itself.
+    monkeypatch.setattr(subprocess, "run", fake_run)
+
+
+def test_budget_exhaustion_raises_timeout(tpu_env):
+    calls = []
+    _hang_probe(tpu_env, calls)
+    t0 = time.monotonic()
+    with pytest.raises((TimeoutError, subprocess.TimeoutExpired)):
+        plat.wait_for_device(attempts=10, probe_timeout=1, max_wait_s=2.5)
+    # Must stop within the budget (+ small slack), long before the
+    # 10-probe schedule would.
+    assert time.monotonic() - t0 < 10
+    assert 1 <= len(calls) <= 4
+
+
+def test_probe_timeout_clamped_to_remaining_budget(tpu_env):
+    calls = []
+    _hang_probe(tpu_env, calls)
+    with pytest.raises((TimeoutError, subprocess.TimeoutExpired)):
+        plat.wait_for_device(attempts=3, probe_timeout=300, max_wait_s=0.5)
+    assert all(t <= 0.5 for t in calls)
+
+
+def test_env_var_sets_default_budget(tpu_env):
+    tpu_env.setenv("P2P_DEVICE_WAIT_S", "0.01")
+    assert plat.device_wait_budget_s() == 0.01
+    calls = []
+    _hang_probe(tpu_env, calls)
+    t0 = time.monotonic()
+    with pytest.raises((TimeoutError, subprocess.TimeoutExpired)):
+        plat.wait_for_device(attempts=10, probe_timeout=60)
+    assert time.monotonic() - t0 < 5
+
+
+def test_bad_env_var_is_ignored_with_warning(tpu_env, capsys):
+    for bad in ("not-a-number", "nan", "inf", "-5", ""):
+        tpu_env.setenv("P2P_DEVICE_WAIT_S", bad)
+        assert plat.device_wait_budget_s() is None
+        assert "ignoring invalid P2P_DEVICE_WAIT_S" in capsys.readouterr().err
+    tpu_env.delenv("P2P_DEVICE_WAIT_S")
+    assert plat.device_wait_budget_s() is None
+
+
+def test_invalid_env_does_not_clobber_explicit_budget(tpu_env):
+    # nan would defeat every deadline comparison; an explicit caller
+    # budget must survive an unparsable env value.
+    tpu_env.setenv("P2P_DEVICE_WAIT_S", "nan")
+    calls = []
+    _hang_probe(tpu_env, calls)
+    t0 = time.monotonic()
+    with pytest.raises((TimeoutError, subprocess.TimeoutExpired)):
+        plat.wait_for_device(attempts=10, probe_timeout=1, max_wait_s=1.0)
+    assert time.monotonic() - t0 < 10
+
+
+def test_cpu_requested_is_noop(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    # Must return immediately without probing even with a zero budget.
+    t0 = time.monotonic()
+    plat.wait_for_device(max_wait_s=0.0)
+    assert time.monotonic() - t0 < 1
+
+
+def test_successful_probe_returns(tpu_env):
+    def fake_run(cmd, check, timeout, capture_output):
+        return None
+
+    tpu_env.setattr(subprocess, "run", fake_run)
+    plat.wait_for_device(attempts=3, probe_timeout=1, max_wait_s=5.0)
+
+
+def test_bench_fallback_fires_inside_budget(tmp_path):
+    """End-to-end: with the tunnel 'down' (probe forced to fail) and a tiny
+    budget, bench.py must still print its parsed JSON line — the round-1
+    failure mode was the fallback never being reached."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    # A ~0s budget makes the wait raise before any probe can succeed, so
+    # the fallback path fires deterministically even on a box with a live,
+    # fast device.
+    env["P2P_DEVICE_WAIT_S"] = "0.001"
+    env["P2P_BENCH_SMOKE"] = "1"  # reduced shapes; see bench.py
+    proc = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..", "bench.py")],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    import json
+
+    line = proc.stdout.strip().splitlines()[-1]
+    parsed = json.loads(line)
+    assert "value" in parsed and "metric" in parsed and "vs_baseline" in parsed
+    # The fallback must actually have fired and be honestly labeled.
+    assert "falling back" in proc.stderr
+    assert "CPU" in parsed["metric"] and "SMOKE" in parsed["metric"]
