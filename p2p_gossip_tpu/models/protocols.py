@@ -242,6 +242,13 @@ def run_pushpull_sim(
     )
 
 
+class PullCreditBoundError(ValueError):
+    """Pull mode's uint32 ``sent`` accumulator would overflow for this
+    graph/chunk combination. A distinct type so callers with a clean-error
+    convention (the CLI) can convert exactly this precondition failure
+    without masking unrelated ValueErrors."""
+
+
 def _check_pull_credit_bound(graph: Graph, chunk_size: int, schedule) -> None:
     """Pull mode's per-round responder credit is bounded by
     degree x chunk_size (every attempted puller of one hub, each served a
@@ -250,7 +257,7 @@ def _check_pull_credit_bound(graph: Graph, chunk_size: int, schedule) -> None:
     eff_chunk = min(chunk_size, max(MIN_CHUNK_SHARES, schedule.num_shares))
     eff_chunk = bitmask.num_words(eff_chunk) * bitmask.WORD_BITS
     if int(graph.max_degree) * eff_chunk >= 1 << 32:
-        raise ValueError(
+        raise PullCreditBoundError(
             "pull-mode per-round sent credit may overflow uint32: "
             f"max degree {graph.max_degree} x chunk {eff_chunk} >= 2^32 — "
             "reduce chunk_size"

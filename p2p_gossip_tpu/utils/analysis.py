@@ -86,7 +86,7 @@ def propagation_latency(
     return PropagationReport(n=n, fractions=tuple(fractions), latency=latency)
 
 
-def message_redundancy(stats: NodeStats) -> dict[str, float]:
+def message_redundancy(stats: NodeStats) -> dict[str, float | None]:
     """Traffic cost of the run: transmissions per unique delivery.
 
     ``sends_per_delivery`` is total share-transmissions (`sent`) over total
@@ -94,6 +94,11 @@ def message_redundancy(stats: NodeStats) -> dict[str, float]:
     transmissions that were duplicates at the receiver (dropped by dedup,
     p2pnode.cc:189) or lost. For pure flooding on a static graph this
     approaches the mean degree — each delivery is paid for ~degree times.
+
+    ``sends_per_delivery`` is None when nothing was delivered (not
+    float('inf'): json.dumps would emit 'Infinity', which is not strict
+    JSON and breaks standard parsers on json-emitting consumers —
+    scripts/protocol_compare.py --json serializes this dict).
     """
     t = stats.totals()
     delivered = t["received"]
@@ -101,7 +106,7 @@ def message_redundancy(stats: NodeStats) -> dict[str, float]:
     return {
         "sent": float(sent),
         "delivered": float(delivered),
-        "sends_per_delivery": sent / delivered if delivered else float("inf"),
+        "sends_per_delivery": sent / delivered if delivered else None,
         "wasted_fraction": 1.0 - delivered / sent if sent else 0.0,
     }
 

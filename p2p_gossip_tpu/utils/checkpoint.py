@@ -21,6 +21,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import time
 import zipfile
 
 import numpy as np
@@ -31,6 +32,11 @@ log = p2plog.get_logger("Checkpoint")
 
 _META_KEY = "__meta_json__"
 _FORMAT_VERSION = 1
+
+#: Legacy stable-name tmps ("<path>.tmp") older than this are reclaimed as
+#: litter; younger ones are left alone in case an older-version writer is
+#: mid-save (see atomic_savez).
+_LEGACY_TMP_MAX_AGE_S = 3600.0
 
 
 def fingerprint(*parts) -> str:
@@ -81,9 +87,14 @@ def atomic_savez(path: str, **arrays) -> None:
             except OSError:
                 pass
     # Legacy orphan from the earlier stable-name scheme ("<path>.tmp"):
-    # nothing writes that name anymore, so it can only be dead litter.
+    # nothing CURRENT writes that name, but an older-version writer still
+    # running could — age-gate the unlink so a mixed-version deployment
+    # can't delete an in-flight tmp (an hour-old legacy tmp is litter; a
+    # fresh one may be someone's live write).
+    legacy = f"{path}.tmp"
     try:
-        os.unlink(f"{path}.tmp")
+        if time.time() - os.path.getmtime(legacy) > _LEGACY_TMP_MAX_AGE_S:
+            os.unlink(legacy)
     except OSError:
         pass
 

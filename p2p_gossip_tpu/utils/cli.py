@@ -190,6 +190,22 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _pull_credit_error(g, chunk_size, sched) -> str | None:
+    """The pull protocol's uint32-credit precondition as a clean CLI
+    error message (None when satisfiable) — every other CLI validation
+    prints 'error: ...' and exits 2 rather than leaking a traceback."""
+    from p2p_gossip_tpu.models.protocols import (
+        PullCreditBoundError,
+        _check_pull_credit_bound,
+    )
+
+    try:
+        _check_pull_credit_bound(g, chunk_size, sched)
+    except PullCreditBoundError as e:
+        return str(e)
+    return None
+
+
 def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
     """Flood coverage-time experiment (BASELINE.json headline config): S
     shares flooded from random origins at t=0, per-share
@@ -218,6 +234,11 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
         from p2p_gossip_tpu.models.generation import Schedule
 
         sched = Schedule(g.n, origins, np.zeros(len(origins), dtype=np.int32))
+        if args.protocol == "pull":
+            err = _pull_credit_error(g, args.chunkSize, sched)
+            if err is not None:
+                print(f"error: {err}", file=sys.stderr)
+                return 2
         kw = dict(fanout=args.fanout) if args.protocol == "pushk" else {}
         if mesh is not None:
             from p2p_gossip_tpu.parallel.protocols_sharded import (
@@ -294,9 +315,10 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
     report = propagation_latency(coverage, g.n)
     print(format_propagation_report(report, tick_ms=args.Latency), end="")
     red = message_redundancy(stats)
+    spd = red["sends_per_delivery"]  # None when nothing was delivered
     print(
-        f"Redundancy: {red['sends_per_delivery']:.2f} sends per delivery "
-        f"({red['wasted_fraction']:.1%} duplicate or lost)"
+        f"Redundancy: {'n/a' if spd is None else f'{spd:.2f}'} sends per "
+        f"delivery ({red['wasted_fraction']:.1%} duplicate or lost)"
     )
     print(
         f"Simulated {horizon} ticks in {wall:.3f}s wall "
@@ -584,6 +606,13 @@ def run(argv=None) -> int:
     if args.checkpointEvery < 1:
         print("error: --checkpointEvery must be >= 1", file=sys.stderr)
         return 2
+    if args.protocol == "pull" and args.backend in ("tpu", "sharded"):
+        # Only the bitmask engines carry the uint32 credit accumulator;
+        # event/native accumulate sent in int64 and have no such bound.
+        err = _pull_credit_error(g, args.chunkSize, sched)
+        if err is not None:
+            print(f"error: {err}", file=sys.stderr)
+            return 2
 
     t0 = time.perf_counter()
     if args.protocol in ("pushpull", "pull", "pushk") and args.backend == "sharded":

@@ -77,7 +77,11 @@ def main() -> None:
             "reached_fraction": s["reached"],
             "ttc_median_ticks": s["median"],
             "final_coverage_mean": float(cov[-1].mean()),
-            "sends_per_delivery": round(red["sends_per_delivery"], 2),
+            "sends_per_delivery": (
+                None
+                if red["sends_per_delivery"] is None
+                else round(red["sends_per_delivery"], 2)
+            ),
             "total_sent": int(stats.sent.sum()),
             "p95_latency_ticks": s["p95"],
             "wall_s": round(wall, 3),

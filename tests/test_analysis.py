@@ -79,3 +79,22 @@ def test_propagation_latency_rejects_bad_fraction():
 
     with pytest.raises(ValueError):
         propagation_latency(np.zeros((4, 1)), n=10, fractions=(0.0,))
+
+
+def test_message_redundancy_zero_delivery_is_json_safe():
+    """No deliveries -> sends_per_delivery is None, never float('inf'):
+    json.dumps(inf) emits 'Infinity', which is not strict JSON and breaks
+    standard parsers on json-emitting consumers (protocol_compare.py
+    --json serializes this dict)."""
+    import json
+
+    from p2p_gossip_tpu.utils.stats import NodeStats
+
+    z = np.zeros(4, dtype=np.int64)
+    stats = NodeStats(
+        generated=z, received=z, forwarded=z, sent=z + 3, processed=z,
+        degree=z + 1,
+    )
+    red = message_redundancy(stats)
+    assert red["sends_per_delivery"] is None
+    assert json.loads(json.dumps(red))["sends_per_delivery"] is None

@@ -252,14 +252,23 @@ def test_atomic_savez_reclaims_dead_writer_tmps(tmp_path):
     path = str(tmp_path / "x.npz")
     dead = f"{path}.999999999.tmp"             # no such pid
     legacy = f"{path}.tmp"                      # pre-pid-scheme orphan
+    fresh_legacy = str(tmp_path / "y.npz") + ".tmp"  # maybe someone's live write
     live = f"{path}.{os.getppid()}.tmp"         # a genuinely live pid
     odd = f"{path}.notapid.x.tmp"               # unparsable pid slot
     for p, content in ((dead, b"torn"), (legacy, b"old"),
+                       (fresh_legacy, b"new"),
                        (live, b"inflight"), (odd, b"?")):
         open(p, "wb").write(content)
+    # Age the stale legacy tmp past the reclaim gate; fresh_legacy keeps
+    # its just-written mtime (an older-version writer could still be
+    # mid-save on that name).
+    old = C.time.time() - C._LEGACY_TMP_MAX_AGE_S - 10
+    os.utime(legacy, (old, old))
     C.atomic_savez(path, a=np.arange(3))
+    C.atomic_savez(str(tmp_path / "y.npz"), a=np.arange(3))
     assert not os.path.exists(dead)
     assert not os.path.exists(legacy)
+    assert os.path.exists(fresh_legacy)  # young legacy tmp -> untouched
     assert os.path.exists(live)   # live writer untouched
     assert os.path.exists(odd)    # unparsable -> untouched
     with np.load(path) as d:

@@ -266,3 +266,37 @@ def test_json_rejected_with_flood_coverage(capsys):
 
     rc = run(["--numNodes", "20", "--floodCoverage", "4", "--json"])
     assert rc == 2
+
+
+def test_pull_credit_bound_is_a_clean_cli_error(capsys):
+    """The pull protocol's uint32-credit precondition surfaces as the
+    CLI's 'error: ...' + exit 2 convention, not a raw traceback."""
+    from unittest import mock
+
+    from p2p_gossip_tpu.models.topology import Graph
+    from p2p_gossip_tpu.utils import cli
+
+    common = [
+        "--numNodes", "20", "--connectionProb", "0.3", "--simTime", "5",
+        "--backend", "tpu", "--protocol", "pull", "--seed", "0",
+    ]
+    with mock.patch.object(
+        Graph, "max_degree", property(lambda self: 1 << 20)
+    ):
+        rc = cli.run(common)
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "error:" in err and "uint32" in err
+        # Same conversion on the --floodCoverage dispatch path.
+        rc = cli.run(common + ["--floodCoverage", "4"])
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "error:" in err and "uint32" in err
+        # The bound is a bitmask-engine precondition only; the event
+        # backend accumulates sent in int64 and must not be gated.
+        rc = cli.run([
+            "--numNodes", "20", "--connectionProb", "0.3", "--simTime", "5",
+            "--backend", "event", "--protocol", "pull", "--seed", "0",
+        ])
+        capsys.readouterr()
+        assert rc == 0
