@@ -138,6 +138,52 @@ def propagate(
     return arrivals
 
 
+@functools.partial(jax.jit, static_argnames=("block", "loss"))
+def gather_or_frontier(
+    frontier: jnp.ndarray,  # (N_src, W) uint32 — ONE delay slice of history
+    tick: jnp.ndarray,      # scalar int32 — arrival tick (loss coin input)
+    ell_idx: jnp.ndarray,   # (N_out, dmax) int32
+    ell_mask: jnp.ndarray,  # (N_out, dmax) bool
+    *,
+    block: int = DEFAULT_DEGREE_BLOCK,
+    loss: tuple | None = None,
+    dst_ids: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """OR-gather arrivals from a single source frontier.
+
+    The shared core of `propagate_uniform` and the sharded engine's
+    sharded-ring read path: the caller has already resolved WHICH past
+    frontier each edge reads (one slice per uniform delay value), so this
+    is a pure (N_out, dmax)-edge gather-OR over one (N_src, W) array.
+    ``tick`` is the ARRIVAL tick — the loss coin hashes (src, dst, t), so
+    it must be the same t every engine uses, regardless of which past
+    slice is being read."""
+    n_out = ell_idx.shape[0]
+    w = frontier.shape[-1]
+    if loss is not None and dst_ids is None:
+        dst_ids = jnp.arange(n_out, dtype=jnp.int32)
+
+    idx = _pad_degree_axis(ell_idx, block, 0)
+    msk = _pad_degree_axis(ell_mask, block, False)
+    nblocks = idx.shape[1] // block
+    idx = idx.reshape(n_out, nblocks, block).transpose(1, 0, 2)
+    msk = msk.reshape(n_out, nblocks, block).transpose(1, 0, 2)
+
+    def body(acc, blk):
+        b_idx, b_msk = blk
+        gathered = frontier[b_idx]  # (N_out, B, W)
+        keep = b_msk
+        if loss is not None:
+            keep = keep & _loss_keep(b_idx, dst_ids, tick, loss)
+        gathered = jnp.where(keep[..., None], gathered, jnp.uint32(0))
+        acc = acc | lax.reduce(gathered, jnp.uint32(0), lax.bitwise_or, (1,))
+        return acc, None
+
+    init = jnp.zeros((n_out, w), dtype=jnp.uint32)
+    arrivals, _ = lax.scan(body, init, (idx, msk))
+    return arrivals
+
+
 @functools.partial(
     jax.jit, static_argnames=("ring_size", "block", "uniform_delay", "loss")
 )
@@ -157,33 +203,51 @@ def propagate_uniform(
     -latency model): the delay-line slot is one scalar per tick, so the
     per-edge delay gather — and the whole (N, dmax) delay array read from
     HBM — disappears. ``loss``/``dst_ids`` as in `propagate`."""
-    d, n_src, w = hist.shape
-    n_out = ell_idx.shape[0]
+    d = hist.shape[0]
     assert d == ring_size
     # One source frontier for the whole tick.
     src = hist[jnp.mod(tick - uniform_delay, ring_size)]  # (N_src, W)
-    if loss is not None and dst_ids is None:
-        dst_ids = jnp.arange(n_out, dtype=jnp.int32)
+    return gather_or_frontier(
+        src, tick, ell_idx, ell_mask, block=block, loss=loss, dst_ids=dst_ids
+    )
 
-    idx = _pad_degree_axis(ell_idx, block, 0)
-    msk = _pad_degree_axis(ell_mask, block, False)
-    nblocks = idx.shape[1] // block
-    idx = idx.reshape(n_out, nblocks, block).transpose(1, 0, 2)
-    msk = msk.reshape(n_out, nblocks, block).transpose(1, 0, 2)
 
-    def body(acc, blk):
-        b_idx, b_msk = blk
-        gathered = src[b_idx]  # (N_out, B, W)
-        keep = b_msk
-        if loss is not None:
-            keep = keep & _loss_keep(b_idx, dst_ids, tick, loss)
-        gathered = jnp.where(keep[..., None], gathered, jnp.uint32(0))
-        acc = acc | lax.reduce(gathered, jnp.uint32(0), lax.bitwise_or, (1,))
-        return acc, None
+def split_ell_by_delay(ell_idx, ell_delay, ell_mask):
+    """Partition ELL columns by delay value — the sharded-ring read plan.
 
-    init = jnp.zeros((n_out, w), dtype=jnp.uint32)
-    arrivals, _ = lax.scan(body, init, (idx, msk))
-    return arrivals
+    Per-edge delays are STATIC host data, so the set of distinct values is
+    known before compile; splitting the ELL into one (idx, mask) pair per
+    delay value turns the per-edge-delay gather into a handful of
+    single-frontier gathers (`gather_or_frontier`), each reading ONE past
+    slice of a source-sharded history ring. Each pair is packed left
+    (valid edges first) and trimmed to its own max row count, so the total
+    gather traffic stays ~the full ELL's plus per-delay padding.
+
+    Returns a tuple of ``(delay_value, idx_d, mask_d)``; the masks
+    partition the valid entries of ``ell_mask``.
+    """
+    import numpy as np
+
+    ell_idx = np.asarray(ell_idx)
+    ell_delay = np.asarray(ell_delay)
+    ell_mask = np.asarray(ell_mask)
+    values = np.unique(ell_delay[ell_mask])
+    if values.size == 0:
+        # Degenerate (all rows padding): one vacuous pair keeps the
+        # consumer's loop non-empty.
+        return ((1, ell_idx[:, :1], np.zeros_like(ell_mask[:, :1])),)
+    out = []
+    for d in values:
+        m = ell_mask & (ell_delay == d)
+        cap = max(int(m.sum(axis=1).max()), 1)
+        # Valid-first stable permutation packs each row's delay-d edges
+        # into the leading columns.
+        order = np.argsort(~m, axis=1, kind="stable")
+        idx_d = np.take_along_axis(ell_idx, order, axis=1)[:, :cap]
+        msk_d = np.take_along_axis(m, order, axis=1)[:, :cap]
+        out.append((int(d), np.ascontiguousarray(idx_d),
+                    np.ascontiguousarray(msk_d)))
+    return tuple(out)
 
 
 def build_degree_buckets(

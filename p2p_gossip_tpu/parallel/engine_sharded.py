@@ -3,15 +3,32 @@
 Scales the tick engine (engine/sync.py) the way the BASELINE.json headline
 config demands (1M nodes over a v5e-8 mesh): graph rows, seen-bitmask, and
 counters are sharded along ``nodes``; independent share chunks along
-``shares``. Per tick each node shard computes arrivals for its rows by
-gathering from the *global* newly-frontier history, then contributes its own
-newly-frontier via `lax.all_gather` over the nodes axis — the one collective
-on the hot path, sized (N x W_slice) words, riding ICI. Counters `psum` over
-the shares axis once per pass.
+``shares``. Counters `psum` over the shares axis once per pass.
 
-Single-device equivalence is bitwise: the sharded engine runs the same tick
-body (`ops.ell.propagate` + bitmask updates) on row shards, and the tests
-assert identical per-node counters against `engine.sync` and `engine.event`.
+The delay-line history ring has two layouts (``ring_mode``):
+
+- ``"replicated"`` — each chip holds the full (ring, N, W) ring; per tick
+  the local newly-frontier is `all_gather`ed over the nodes axis and
+  written globally, and the gather-OR reads are purely local. Fastest
+  when the ring fits in HBM.
+- ``"sharded"`` — each chip holds only ITS rows' history (ring, N/shards,
+  W); per-chip ring memory scales down with the mesh. Per-edge delays are
+  static host data, so the read side becomes one `all_gather` of the
+  (t - d)-slice per distinct delay value d (`ops.ell.split_ell_by_delay`
+  plans the per-delay ELLs): for the reference's uniform-latency model
+  that is exactly ONE all_gather per tick — the same ICI traffic as
+  replicated mode with 1/n_shards the ring HBM — and for an L-valued
+  delay distribution it is L all_gathers (traffic xL, the price of
+  fitting 1M-node lognormal rings on 16 GB chips).
+
+``"auto"`` picks sharded for uniform delays (strictly better) and
+otherwise switches to sharded when the replicated ring would exceed
+``RING_REPLICATED_MAX_BYTES`` per chip.
+
+Single-device equivalence is bitwise for BOTH layouts: the tick body ORs
+the same edge set in either decomposition, and the tests assert identical
+per-node counters against `engine.sync` and `engine.event` across mesh
+shapes and ring modes.
 """
 
 from __future__ import annotations
@@ -38,8 +55,9 @@ from p2p_gossip_tpu.ops import bitmask
 from p2p_gossip_tpu.ops.ell import (
     DEFAULT_DEGREE_BLOCK,
     detect_uniform_delay,
+    gather_or_frontier,
     propagate,
-    propagate_uniform,
+    split_ell_by_delay,
     tuned_degree_block,
 )
 from p2p_gossip_tpu.parallel.mesh import NODES_AXIS, SHARES_AXIS, pad_to_multiple
@@ -99,6 +117,98 @@ def _padded_churn(churn, n_padded: int, n_node_shards: int):
     )
 
 
+#: Per-chip ceiling for the replicated (ring, N, W) history under
+#: ring_mode="auto": above this the sharded ring layout is chosen. The
+#: v5e has 16 GB HBM; 1 GiB of replicated ring leaves the rest for ELL,
+#: seen, and the gather intermediates.
+RING_REPLICATED_MAX_BYTES = 1 << 30
+
+
+def resolve_ring_mode(
+    ring_mode: str,
+    uniform: int | None,
+    ring: int,
+    n_padded: int,
+    n_node_shards: int,
+    w: int,
+) -> tuple[str, int]:
+    """Resolve "auto" and return (mode, per-chip ring bytes).
+
+    Uniform delays always take the sharded ring (same ICI traffic, 1/shards
+    the HBM); per-edge delays stay replicated until the replicated ring
+    would exceed RING_REPLICATED_MAX_BYTES per chip (the sharded read side
+    costs one all_gather per distinct delay value per tick)."""
+    if ring_mode not in ("auto", "replicated", "sharded"):
+        raise ValueError(f"unknown ring_mode {ring_mode!r}")
+    replicated_bytes = 4 * ring * n_padded * w
+    if ring_mode == "auto":
+        if uniform is not None or replicated_bytes > RING_REPLICATED_MAX_BYTES:
+            ring_mode = "sharded"
+        else:
+            ring_mode = "replicated"
+    bytes_per_chip = (
+        replicated_bytes
+        if ring_mode == "replicated"
+        else 4 * ring * (n_padded // n_node_shards) * w
+    )
+    return ring_mode, bytes_per_chip
+
+
+def _resolve_and_stage_ring(
+    ring_mode: str,
+    uniform: int | None,
+    ring: int,
+    n_padded: int,
+    n_node_shards: int,
+    w: int,
+    ell_idx: np.ndarray,
+    ell_delay: np.ndarray,
+    ell_mask: np.ndarray,
+):
+    """Resolve the ring layout and stage its operands in one step — the
+    shared stanza of both sharded entry points. Returns
+    (ring_mode, ell_args, delay_values, ring_extra) where ``ring_extra``
+    is the ``stats.extra['ring']`` report dict."""
+    ring_mode, ring_bytes = resolve_ring_mode(
+        ring_mode, uniform, ring, n_padded, n_node_shards, w
+    )
+    ell_args, delay_values = _stage_ell_args(
+        ring_mode, uniform, ell_idx, ell_delay, ell_mask
+    )
+    ring_extra = {
+        "mode": ring_mode,
+        "bytes_per_chip": ring_bytes,
+        "slots": ring,
+        "delay_splits": len(delay_values) if delay_values else 1,
+    }
+    return ring_mode, ell_args, delay_values, ring_extra
+
+
+def _stage_ell_args(
+    ring_mode: str,
+    uniform: int | None,
+    ell_idx: np.ndarray,
+    ell_delay: np.ndarray,
+    ell_mask: np.ndarray,
+):
+    """The runner's propagation operands for the resolved ring layout:
+    (ell_args flat tuple, static delay_values or None).
+
+    - uniform delay (either layout): (idx, mask) — no delay array at all
+    - replicated per-edge: (idx, delay, mask)
+    - sharded per-edge: per-delay (idx_d, mask_d) pairs, one frontier
+      all_gather each (see split_ell_by_delay)
+    """
+    if uniform is not None:
+        return (ell_idx, ell_mask), None
+    if ring_mode == "replicated":
+        return (ell_idx, ell_delay, ell_mask), None
+    splits = split_ell_by_delay(ell_idx, ell_delay, ell_mask)
+    delay_values = tuple(d for d, _, _ in splits)
+    ell_args = tuple(x for _, i, m in splits for x in (i, m))
+    return ell_args, delay_values
+
+
 def _stage_sharded_inputs(
     graph: Graph,
     ell_delays: np.ndarray | None,
@@ -139,11 +249,17 @@ def build_sharded_runner(
     loss: tuple | None = None,
     record_coverage: bool = False,
     cov_slots: int | None = None,
+    ring_mode: str = "replicated",
+    delay_values: tuple | None = None,
 ):
     """Compile the per-pass runner: each shares-shard processes its own
     ``chunk_size`` shares over the row-sharded graph, from the chunk's first
     generation tick to quiescence. Memoized so repeated calls with the same
     mesh/shapes reuse the jitted executable.
+
+    The first runner argument is the flat ``ell_args`` tuple staged by
+    `_stage_ell_args` for (``ring_mode``, ``uniform_delay``,
+    ``delay_values``); its layout is part of the compiled signature.
 
     ``num_snaps`` > 0 additionally returns (num_snaps, n_loc) received
     counts captured when the tick counter reaches each entry of the
@@ -164,19 +280,21 @@ def build_sharded_runner(
     if cov_slots is None:
         cov_slots = chunk_size
     cov_w = bitmask.num_words(cov_slots)
+    sharded_ring = ring_mode == "sharded"
+    hist_rows = n_loc if sharded_ring else n_padded
 
     def local_coverage(seen):
         return bitmask.coverage_per_slot(seen[:, :cov_w], cov_slots)
 
     def pass_fn(
-        ell_idx, ell_delay, ell_mask, degree, churn_start, churn_end,
+        ell_args, degree, churn_start, churn_end,
         origins, gen_ticks, t_start, last_gen, snap_ticks,
     ):
-        # Local shapes: ell_* (n_loc, dmax); churn_* (n_loc, K) downtime
-        # intervals ((n_loc, 1) zeros when churn is off — the compare is
-        # vacuously up); origins/gen_ticks (chunk_size,); t_start/last_gen
-        # scalars (min/max over ALL slices, so loop trip counts agree across
-        # devices); snap_ticks (num_snaps,) replicated.
+        # Local shapes: ell_args arrays (n_loc, cols); churn_* (n_loc, K)
+        # downtime intervals ((n_loc, 1) zeros when churn is off — the
+        # compare is vacuously up); origins/gen_ticks (chunk_size,);
+        # t_start/last_gen scalars (min/max over ALL slices, so loop trip
+        # counts agree across devices); snap_ticks (num_snaps,) replicated.
         row_offset = lax.axis_index(NODES_AXIS).astype(jnp.int32) * n_loc
         slots = jnp.arange(chunk_size, dtype=jnp.int32)
         # Global node ids of this shard's rows — the loss coin hashes
@@ -191,7 +309,8 @@ def build_sharded_runner(
         state = (
             t_start,
             jnp.zeros((n_loc, w), dtype=jnp.uint32),              # seen (local)
-            jnp.zeros((ring_size, n_padded, w), dtype=jnp.uint32),  # hist (global rows)
+            # History ring: global rows (replicated) or local rows (sharded).
+            jnp.zeros((ring_size, hist_rows, w), dtype=jnp.uint32),
             jnp.zeros((n_loc,), dtype=jnp.int32),                 # received
             jnp.zeros((n_loc,), dtype=jnp.int32),                 # sent
             jnp.zeros((num_snaps, n_loc), dtype=jnp.int32),       # snapshots
@@ -204,12 +323,51 @@ def build_sharded_runner(
 
         def cond(state):
             t, _, hist, _, _, _, _ = state
+            # Local ring rows are a subset (sharded) or a replica
+            # (replicated) of the global frontier state; the mesh-wide
+            # OR-reduce makes the predicate uniform either way.
             in_flight = jnp.any(hist != 0)
-            # Uniform predicate across every device: OR-reduce over the mesh.
             in_flight = lax.psum(
                 in_flight.astype(jnp.int32), (SHARES_AXIS, NODES_AXIS)
             ) > 0
             return (t < horizon) & (in_flight | (t <= last_gen))
+
+        def read_slice(hist, t, delay):
+            """The global (t - delay) frontier: a local ring read when the
+            ring is replicated, an all_gather of the local slice when it is
+            sharded — the read-time frontier exchange, riding ICI."""
+            sl = hist[jnp.mod(t - delay, ring_size)]
+            if sharded_ring:
+                sl = lax.all_gather(sl, NODES_AXIS, axis=0, tiled=True)
+            return sl
+
+        def arrivals_for(hist, t):
+            if uniform_delay is not None:
+                ell_idx, ell_mask = ell_args
+                return gather_or_frontier(
+                    read_slice(hist, t, uniform_delay), t, ell_idx, ell_mask,
+                    block=block, loss=loss, dst_ids=dst_ids,
+                )
+            if not sharded_ring:
+                ell_idx, ell_delay, ell_mask = ell_args
+                return propagate(
+                    hist, t, ell_idx, ell_delay, ell_mask,
+                    ring_size=ring_size, block=block,
+                    loss=loss, dst_ids=dst_ids,
+                )
+            # Sharded ring + per-edge delays: one single-frontier gather
+            # per distinct delay value (the delay-split ELLs partition the
+            # edge set, so the OR over parts equals the full-ELL gather).
+            acc = jnp.zeros((n_loc, w), dtype=jnp.uint32)
+            for k, dval in enumerate(delay_values):
+                idx_d = ell_args[2 * k]
+                msk_d = ell_args[2 * k + 1]
+                acc = acc | gather_or_frontier(
+                    read_slice(hist, t, dval), t, idx_d, msk_d,
+                    block=max(1, min(block, idx_d.shape[1])),
+                    loss=loss, dst_ids=dst_ids,
+                )
+            return acc
 
         def body(state):
             t, seen, hist, received, sent, snaps, cov_hist = state
@@ -217,18 +375,7 @@ def build_sharded_runner(
                 snaps = jnp.where(
                     (snap_ticks == t)[:, None], received[None, :], snaps
                 )
-            if uniform_delay is not None:
-                arrivals = propagate_uniform(
-                    hist, t, ell_idx, ell_mask,
-                    ring_size=ring_size, uniform_delay=uniform_delay,
-                    block=block, loss=loss, dst_ids=dst_ids,
-                )
-            else:
-                arrivals = propagate(
-                    hist, t, ell_idx, ell_delay, ell_mask,
-                    ring_size=ring_size, block=block,
-                    loss=loss, dst_ids=dst_ids,
-                )
+            arrivals = arrivals_for(hist, t)
             up = up_mask_jnp(churn_start, churn_end, t)
             arrivals = jnp.where(up[:, None], arrivals, jnp.uint32(0))
             local_rows = origins - row_offset
@@ -249,9 +396,16 @@ def build_sharded_runner(
             seen, newly_out, received, sent = apply_tick_updates(
                 seen, arrivals, gen_bits, gen_cnt, received, sent, degree
             )
-            # The frontier exchange: local newly -> global rows, over ICI.
-            newly_full = lax.all_gather(newly_out, NODES_AXIS, axis=0, tiled=True)
-            hist = hist.at[jnp.mod(t, ring_size)].set(newly_full)
+            if sharded_ring:
+                # Local write; the frontier exchange happens at READ time
+                # (read_slice), so per-chip ring HBM is n_loc rows.
+                hist = hist.at[jnp.mod(t, ring_size)].set(newly_out)
+            else:
+                # Write-time frontier exchange: local newly -> global rows.
+                newly_full = lax.all_gather(
+                    newly_out, NODES_AXIS, axis=0, tiled=True
+                )
+                hist = hist.at[jnp.mod(t, ring_size)].set(newly_full)
             if record_coverage:
                 cov = lax.psum(local_coverage(seen), NODES_AXIS)
                 cov_hist = lax.dynamic_update_slice(cov_hist, cov[None], (t, 0))
@@ -276,13 +430,15 @@ def build_sharded_runner(
         snaps = lax.psum(snaps, SHARES_AXIS)
         return received, sent, snaps, cov_hist
 
+    n_ell_args = (
+        2 if uniform_delay is not None
+        else (3 if not sharded_ring else 2 * len(delay_values))
+    )
     mapped = shard_map(
         pass_fn,
         mesh=mesh,
         in_specs=(
-            P(NODES_AXIS, None),  # ell_idx
-            P(NODES_AXIS, None),  # ell_delay
-            P(NODES_AXIS, None),  # ell_mask
+            tuple(P(NODES_AXIS, None) for _ in range(n_ell_args)),  # ell_args
             P(NODES_AXIS),        # degree
             P(NODES_AXIS, None),  # churn_start
             P(NODES_AXIS, None),  # churn_end
@@ -316,6 +472,7 @@ def run_sharded_sim(
     checkpoint_path: str | None = None,
     checkpoint_every: int = 1,
     stop_after_chunks: int | None = None,
+    ring_mode: str = "auto",
 ) -> NodeStats:
     """Drop-in counterpart of run_sync_sim/run_event_sim on a device mesh:
     identical per-node counters, any number of shares — including under a
@@ -337,7 +494,12 @@ def run_sharded_sim(
     passes, a restart with identical inputs resumes after the last
     completed pass, and a checkpoint from any different configuration
     (including a different mesh shape) is detected by fingerprint and
-    ignored."""
+    ignored.
+
+    ``ring_mode`` selects the history-ring layout (module docstring):
+    "replicated", "sharded", or "auto" (default); counters are bitwise
+    identical either way, and the resolved choice is reported in
+    ``stats.extra['ring']`` with its per-chip byte cost."""
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
     (ell_idx, ell_delay, ell_mask, degree, ring, uniform, n_padded, block,
      churn_start, churn_end) = _stage_sharded_inputs(
@@ -345,10 +507,15 @@ def run_sharded_sim(
     )
     boundaries = filter_snapshot_boundaries(snapshot_ticks, horizon_ticks)
     snap_ticks_arr = np.asarray(boundaries, dtype=np.int32)
+    ring_mode, ell_args, delay_values, ring_extra = _resolve_and_stage_ring(
+        ring_mode, uniform, ring, n_padded, mesh.shape[NODES_AXIS],
+        bitmask.num_words(chunk_size), ell_idx, ell_delay, ell_mask,
+    )
     runner, pass_size = build_sharded_runner(
         mesh, n_padded, ring, chunk_size, horizon_ticks, block, uniform,
         len(boundaries),
         loss.static_cfg if loss is not None else None,
+        ring_mode=ring_mode, delay_values=delay_values,
     )
 
     received = np.zeros(n_padded, dtype=np.int64)
@@ -395,7 +562,7 @@ def run_sharded_sim(
             t_start = np.int32(chunk.gen_ticks[live].min())
             last_gen = np.int32(chunk.gen_ticks[live].max())
             r, s, sn, _ = runner(
-                ell_idx, ell_delay, ell_mask, degree, churn_start, churn_end,
+                ell_args, degree, churn_start, churn_end,
                 origins, gen_ticks, t_start, last_gen, snap_ticks_arr,
             )
             received += np.asarray(r, dtype=np.int64)
@@ -414,6 +581,7 @@ def run_sharded_sim(
         processed=generated + received,
         degree=graph.degree.astype(np.int64),
     )
+    stats.extra["ring"] = ring_extra
     if snapshot_ticks is not None:
         stats.extra["snapshots"] = assemble_snapshots(
             schedule, churn, boundaries, snap_received[:, : graph.n],
@@ -433,6 +601,7 @@ def run_sharded_flood_coverage(
     block: int | None = None,
     churn=None,
     loss=None,
+    ring_mode: str = "auto",
 ):
     """Flood coverage-time experiment on the device mesh — the BASELINE
     north-star metric (time-to-99% coverage at 1M nodes on a v5e-8 mesh)
@@ -456,13 +625,18 @@ def run_sharded_flood_coverage(
      churn_start, churn_end) = _stage_sharded_inputs(
         graph, ell_delays, constant_delay, mesh, block, churn
     )
+    ring_mode, ell_args, delay_values, ring_extra = _resolve_and_stage_ring(
+        ring_mode, uniform, ring, n_padded, mesh.shape[NODES_AXIS],
+        bitmask.num_words(chunk_size), ell_idx, ell_delay, ell_mask,
+    )
     runner, pass_size = build_sharded_runner(
         mesh, n_padded, ring, chunk_size, horizon_ticks, block, uniform,
         0, loss.static_cfg if loss is not None else None, True, cov_slots,
+        ring_mode=ring_mode, delay_values=delay_values,
     )
     o, g_ticks = sched.padded(pass_size, horizon_ticks)
     r, snt, _, cov = runner(
-        ell_idx, ell_delay, ell_mask, degree, churn_start, churn_end,
+        ell_args, degree, churn_start, churn_end,
         o, g_ticks, np.int32(0), np.int32(0),
         np.zeros((0,), dtype=np.int32),
     )
@@ -485,4 +659,5 @@ def run_sharded_flood_coverage(
         parts.append(cov[:, k * cov_slots : k * cov_slots + live_k])
     coverage = np.concatenate(parts, axis=1)
     stats.extra["coverage"] = coverage
+    stats.extra["ring"] = ring_extra
     return stats, coverage

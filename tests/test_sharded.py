@@ -126,3 +126,101 @@ def test_sharded_flood_coverage_under_loss():
     )
     assert np.array_equal(cov_s, cov_m)
     assert np.array_equal(st_s.received, st_m.received)
+
+
+@pytest.mark.parametrize("shards", [(8, 1), (4, 2), (2, 4)])
+@pytest.mark.parametrize("ring_mode", ["replicated", "sharded"])
+def test_ring_modes_bitwise_equal_per_edge_delays(ring_mode, shards):
+    """Both ring layouts produce identical counters under a spread of
+    per-edge delays (the sharded layout reads via per-delay-value
+    frontier all_gathers; see engine_sharded module docstring)."""
+    ns, ss = shards
+    g = pg.erdos_renyi(80, 0.08, seed=7)
+    d = lognormal_delays(g, mean_ticks=2.5, sigma=0.8, max_ticks=6, seed=7)
+    sched = pg.uniform_renewal_schedule(80, sim_time=6.0, tick_dt=0.01, seed=7)
+    ev = run_event_sim(g, sched, 600, ell_delays=d)
+    sh = run_sharded_sim(
+        g, sched, 600, _cpu_mesh(ns, ss), ell_delays=d, chunk_size=32,
+        ring_mode=ring_mode,
+    )
+    assert sh.equal_counts(ev)
+    assert sh.extra["ring"]["mode"] == ring_mode
+    if ring_mode == "sharded":
+        assert sh.extra["ring"]["delay_splits"] > 1
+
+
+def test_ring_modes_bitwise_equal_with_loss_and_churn():
+    """The loss coin hashes global (src, dst, t) and churn masks arrivals
+    post-OR, so neither may depend on the ring layout."""
+    g = pg.erdos_renyi(64, 0.1, seed=9)
+    d = lognormal_delays(g, mean_ticks=2.0, sigma=0.6, max_ticks=4, seed=9)
+    sched = pg.uniform_renewal_schedule(64, sim_time=5.0, tick_dt=0.01, seed=9)
+    loss = pg.LinkLossModel(0.25, seed=4)
+    churn = pg.random_churn(
+        64, 500, outage_prob=0.3, mean_down_ticks=40, seed=5
+    )
+    ev = run_event_sim(g, sched, 500, ell_delays=d, loss=loss, churn=churn)
+    runs = {
+        mode: run_sharded_sim(
+            g, sched, 500, _cpu_mesh(4, 2), ell_delays=d, chunk_size=32,
+            loss=loss, churn=churn, ring_mode=mode,
+        )
+        for mode in ("replicated", "sharded")
+    }
+    for mode, sh in runs.items():
+        assert sh.equal_counts(ev), f"ring_mode={mode} diverges"
+
+
+def test_ring_auto_policy_and_memory_accounting():
+    """auto -> sharded for uniform delays (same traffic, 1/shards HBM);
+    replicated for small per-edge rings; per-chip bytes reported."""
+    from p2p_gossip_tpu.parallel.engine_sharded import (
+        RING_REPLICATED_MAX_BYTES,
+        resolve_ring_mode,
+    )
+
+    # Uniform delay: always sharded.
+    mode, b = resolve_ring_mode("auto", 1, 2, 1024, 8, 4)
+    assert mode == "sharded" and b == 4 * 2 * (1024 // 8) * 4
+    # Small per-edge ring: replicated.
+    mode, b = resolve_ring_mode("auto", None, 4, 1024, 8, 4)
+    assert mode == "replicated" and b == 4 * 4 * 1024 * 4
+    # A 1M-node-scale per-edge ring exceeds the ceiling: sharded.
+    n, ring, w = 1_000_000, 8, 256
+    assert 4 * ring * n * w > RING_REPLICATED_MAX_BYTES
+    mode, b = resolve_ring_mode("auto", None, ring, n, 8, w)
+    assert mode == "sharded" and b == 4 * ring * (n // 8) * w
+
+    # End-to-end: a uniform-delay run reports the sharded ring.
+    g = pg.erdos_renyi(48, 0.12, seed=3)
+    sched = pg.uniform_renewal_schedule(48, sim_time=4.0, tick_dt=0.01, seed=3)
+    ev = run_event_sim(g, sched, 400)
+    sh = run_sharded_sim(g, sched, 400, _cpu_mesh(4, 2), chunk_size=32)
+    assert sh.equal_counts(ev)
+    assert sh.extra["ring"]["mode"] == "sharded"
+
+
+def test_split_ell_by_delay_partitions_edges():
+    from p2p_gossip_tpu.ops.ell import split_ell_by_delay
+
+    g = pg.erdos_renyi(40, 0.15, seed=11)
+    d = lognormal_delays(g, mean_ticks=2.0, sigma=0.7, max_ticks=5, seed=11)
+    ell_idx, ell_mask = g.ell()
+    splits = split_ell_by_delay(ell_idx, d, ell_mask)
+    # Valid (row, neighbor) pairs partition exactly.
+    seen_pairs = set()
+    for dval, idx_d, msk_d in splits:
+        rows, cols = np.nonzero(msk_d)
+        for r, c in zip(rows, cols):
+            pair = (int(r), int(idx_d[r, c]))
+            assert pair not in seen_pairs
+            seen_pairs.add(pair)
+            # Every packed edge really has this delay in the source ELL.
+            src_cols = np.nonzero(
+                (ell_idx[r] == idx_d[r, c]) & ell_mask[r]
+            )[0]
+            assert any(d[r, sc] == dval for sc in src_cols)
+    expect = {
+        (int(r), int(ell_idx[r, c])) for r, c in zip(*np.nonzero(ell_mask))
+    }
+    assert seen_pairs == expect
