@@ -29,7 +29,11 @@ from p2p_gossip_tpu.models.topology import (
 )
 from p2p_gossip_tpu.models.generation import uniform_renewal_schedule, poisson_schedule, Schedule
 from p2p_gossip_tpu.models.churn import ChurnModel, from_intervals, random_churn
-from p2p_gossip_tpu.models.latency import constant_delays, lognormal_delays
+from p2p_gossip_tpu.models.latency import (
+    constant_delays,
+    lognormal_delays,
+    serialization_delays,
+)
 from p2p_gossip_tpu.models.linkloss import LinkLossModel
 from p2p_gossip_tpu.utils.stats import NodeStats
 
@@ -60,6 +64,7 @@ __all__ = [
     "random_churn",
     "constant_delays",
     "lognormal_delays",
+    "serialization_delays",
     "LinkLossModel",
     "NodeStats",
 ]

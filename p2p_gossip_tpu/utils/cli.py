@@ -23,7 +23,10 @@ import numpy as np
 
 from p2p_gossip_tpu.models import topology as topo
 from p2p_gossip_tpu.models.generation import poisson_schedule, uniform_renewal_schedule
-from p2p_gossip_tpu.models.latency import lognormal_delays
+from p2p_gossip_tpu.models.latency import (
+    lognormal_delays,
+    serialization_delays,
+)
 from p2p_gossip_tpu.utils.stats import format_final_statistics
 
 
@@ -106,11 +109,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--genHi", type=float, default=5.0)
     p.add_argument("--poissonRate", type=float, default=0.3, help="shares/s/node")
     p.add_argument(
-        "--delayModel", choices=("constant", "lognormal"), default="constant"
+        "--delayModel",
+        choices=("constant", "lognormal", "serialization"),
+        default="constant",
+        help="Per-edge delay model: constant (reference default), "
+        "lognormal (heterogeneous links), or serialization (latency + "
+        "message size / link bandwidth, the reference's 5 Mbps "
+        "point-to-point links)",
     )
     p.add_argument("--delayMeanTicks", type=float, default=2.0)
     p.add_argument("--delaySigma", type=float, default=0.5)
     p.add_argument("--delayMaxTicks", type=int, default=8)
+    p.add_argument(
+        "--shareBytes", type=int, default=30,
+        help="Message size for --delayModel serialization (the reference "
+        "share struct is ~30 bytes on the wire)",
+    )
+    p.add_argument(
+        "--bandwidthMbps", type=float, default=5.0,
+        help="Link bandwidth for --delayModel serialization "
+        "(reference: 5 Mbps, p2pnetwork.cc:113)",
+    )
     p.add_argument(
         "--churnProb", type=float, default=0.0,
         help="Node churn: probability each node suffers a random outage "
@@ -475,6 +494,17 @@ def run(argv=None) -> int:
         delays = lognormal_delays(
             g, args.delayMeanTicks, args.delaySigma, args.delayMaxTicks,
             seed=args.seed,
+        )
+    elif args.delayModel == "serialization":
+        if args.shareBytes < 0 or args.bandwidthMbps <= 0:
+            print(
+                "error: --shareBytes must be >= 0 and --bandwidthMbps > 0",
+                file=sys.stderr,
+            )
+            return 2
+        delays = serialization_delays(
+            g, message_bytes=args.shareBytes,
+            bandwidth_mbps=args.bandwidthMbps, tick_dt=tick_dt,
         )
 
     if args.degreeBlock < 0:

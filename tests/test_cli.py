@@ -300,3 +300,23 @@ def test_pull_credit_bound_is_a_clean_cli_error(capsys):
         ])
         capsys.readouterr()
         assert rc == 0
+
+
+def test_serialization_delay_model_cli():
+    """--delayModel serialization: tpu and event backends agree, and a
+    larger --shareBytes visibly slows propagation."""
+    common = [
+        "--numNodes", "25", "--connectionProb", "0.2", "--simTime", "8",
+        "--Latency", "5", "--seed", "6", "--delayModel", "serialization",
+        "--shareBytes", "8000",
+    ]
+    ev = _run_cli(*common, "--backend", "event")
+    tp = _run_cli(*common, "--backend", "tpu")
+    assert ev.returncode == 0 and tp.returncode == 0, ev.stderr + tp.stderr
+
+    def node_lines(out):
+        return sorted(l for l in out.splitlines() if l.startswith("Node "))
+
+    assert node_lines(ev.stdout) == node_lines(tp.stdout)
+    bad = _run_cli(*common[:-2], "--shareBytes", "-1", "--backend", "event")
+    assert bad.returncode == 2 and "error:" in bad.stderr

@@ -179,3 +179,41 @@ def test_snapshots_all_past_horizon_empty_list():
     ev = run_event_sim(g, sched, 100, snapshot_ticks=[500])
     sy = run_sync_sim(g, sched, 100, snapshot_ticks=[500])
     assert sy.extra["snapshots"] == ev.extra["snapshots"] == []
+
+
+def test_serialization_delay_model_parity_and_math():
+    """Serialization delay = latency + ceil(size*8/bandwidth/tick_dt)
+    (reference: 5 Mbps p2p links, p2pnetwork.cc:113); event and sync
+    engines agree on the resulting integer-tick delay lines."""
+    import pytest
+
+    from p2p_gossip_tpu.engine.event import run_event_sim
+    from p2p_gossip_tpu.models.latency import serialization_delays
+
+    g = pg.erdos_renyi(60, 0.1, seed=4)
+    # Reference config: 30-byte shares at 5 Mbps, 5 ms ticks -> 48 us
+    # serialization, quantized up to one extra tick of delay.
+    d = serialization_delays(
+        g, message_bytes=30, bandwidth_mbps=5.0, tick_dt=0.005
+    )
+    assert int(d.min()) == int(d.max()) == 2  # 1 latency + 1 serialization
+    # A payload filling >1 tick of link time adds proportionally.
+    d_big = serialization_delays(
+        g, message_bytes=8_000, bandwidth_mbps=5.0, tick_dt=0.005
+    )
+    # 8000 B * 8 / 5e6 = 12.8 ms = 2.56 ticks -> ceil 3, + 1 latency.
+    assert int(d_big.max()) == 4
+    # Zero-size messages cost latency only.
+    d0 = serialization_delays(
+        g, message_bytes=0, bandwidth_mbps=5.0, tick_dt=0.005
+    )
+    assert int(d0.max()) == 1
+    with pytest.raises(ValueError):
+        serialization_delays(g, bandwidth_mbps=0.0)
+    with pytest.raises(ValueError):
+        serialization_delays(g, message_bytes=-1)
+
+    sched = pg.uniform_renewal_schedule(60, sim_time=5.0, tick_dt=0.01, seed=4)
+    ev = run_event_sim(g, sched, 500, ell_delays=d_big)
+    sy = run_sync_sim(g, sched, 500, ell_delays=d_big, chunk_size=32)
+    assert sy.equal_counts(ev)
