@@ -214,25 +214,40 @@ def assemble_snapshots(schedule, churn, boundaries, snap_received, connections):
     return snapshots
 
 
-def apply_tick_updates(seen, arrivals, gen_bits, gen_cnt, received, sent, degree):
+def apply_tick_updates(
+    seen, arrivals, gen_bits, gen_cnt, received, sent, degree,
+    use_pallas: bool = False,
+):
     """The shared counter semantics of one tick (reference: p2pnode.cc
     ReceiveShare/GenerateAndGossipShare): dedup against ``seen``, count
     first-time receives, and charge one send per peer per processed share.
     Returns (seen, newly_out, received, sent) where ``newly_out`` is the
     frontier this node contributes for the next delay-line slot. Used by
     both the single-device and the sharded engines — the bitwise-parity
-    contract between them lives here."""
-    newly = arrivals & ~seen
-    newly_cnt = bitmask.popcount_rows(newly)
-    seen = seen | arrivals | gen_bits
+    contract between them lives here.
+
+    ``use_pallas`` routes the bitmask stage through the fused one-pass
+    kernel (`ops.pallas_kernels.tick_update_pallas`, bitwise-identical);
+    the (N,)-sized counter arithmetic stays in jnp either way."""
+    if use_pallas:
+        from p2p_gossip_tpu.ops.pallas_kernels import tick_update_pallas
+
+        seen, newly_out, newly_cnt = tick_update_pallas(
+            arrivals, seen, gen_bits
+        )
+    else:
+        newly = arrivals & ~seen
+        newly_cnt = bitmask.popcount_rows(newly)
+        seen = seen | arrivals | gen_bits
+        newly_out = newly | gen_bits
     received = received + newly_cnt
     sent = sent + (newly_cnt + gen_cnt) * degree
-    return seen, newly | gen_bits, received, sent
+    return seen, newly_out, received, sent
 
 
 def _tick_body(
     dg: DeviceGraph, block: int, state, origins, slots, gen_ticks, churn=None,
-    loss=None,
+    loss=None, use_pallas_tick: bool = False,
 ):
     """One synchronous tick. state = (t, seen, hist, received, sent).
 
@@ -276,14 +291,18 @@ def _tick_body(
         .add(gen_active.astype(jnp.int32))
     )
     seen, newly_out, received, sent = apply_tick_updates(
-        seen, arrivals, gen_bits, gen_cnt, received, sent, dg.degree
+        seen, arrivals, gen_bits, gen_cnt, received, sent, dg.degree,
+        use_pallas=use_pallas_tick,
     )
     hist = hist.at[jnp.mod(t, dg.ring_size)].set(newly_out)
     return (t + 1, seen, hist, received, sent)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("chunk_size", "horizon", "block", "loss")
+    jax.jit,
+    static_argnames=(
+        "chunk_size", "horizon", "block", "loss", "use_pallas_tick",
+    ),
 )
 def _run_chunk_while(
     dg: DeviceGraph,
@@ -298,6 +317,7 @@ def _run_chunk_while(
     horizon: int,
     block: int,
     loss: tuple | None = None,
+    use_pallas_tick: bool = False,
 ):
     """Run one share chunk to quiescence (or the horizon) under while_loop.
 
@@ -332,7 +352,7 @@ def _run_chunk_while(
             )
         t, seen, hist, received, sent = _tick_body(
             dg, block, (t, seen, hist, received, sent), origins, slots,
-            gen_ticks, churn, loss,
+            gen_ticks, churn, loss, use_pallas_tick,
         )
         return (t, seen, hist, received, sent, snaps)
 
@@ -347,7 +367,7 @@ def _run_chunk_while(
     jax.jit,
     static_argnames=(
         "chunk_size", "horizon", "block", "use_pallas", "coverage_slots",
-        "loss",
+        "loss", "use_pallas_tick",
     ),
 )
 def _run_chunk_coverage(
@@ -362,6 +382,7 @@ def _run_chunk_coverage(
     use_pallas: bool = False,
     coverage_slots: int | None = None,
     loss: tuple | None = None,
+    use_pallas_tick: bool = False,
 ):
     """Coverage-recording run from t=0 — drives the time-to-coverage
     metrics. Returns per-tick coverage (horizon, S) but exits the tick loop
@@ -402,7 +423,7 @@ def _run_chunk_coverage(
         t, seen, hist, received, sent, cov_hist = full_state
         state = _tick_body(
             dg, block, (t, seen, hist, received, sent), origins, slots,
-            gen_ticks, churn, loss,
+            gen_ticks, churn, loss, use_pallas_tick,
         )
         cov_hist = jax.lax.dynamic_update_slice(
             cov_hist, coverage_of(state[1])[None], (t, 0)
@@ -468,6 +489,12 @@ def run_sync_sim(
     chunk_size = min(chunk_size, max(MIN_CHUNK_SHARES, schedule.num_shares))
     # Round chunk size up to whole words.
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
+    # Fused tick-update kernel: TPU-only, inside its hardware-validated
+    # row bound (ops/pallas_kernels.py PALLAS_TICK_MAX_ROWS).
+    from p2p_gossip_tpu.ops.pallas_kernels import tick_rows_ok
+
+    on_tpu = any(d.platform == "tpu" for d in dg.ell_idx.devices())
+    use_pallas_tick = on_tpu and tick_rows_ok(graph.n)
 
     boundaries = filter_snapshot_boundaries(snapshot_ticks, horizon_ticks)
     snap_ticks_dev = (
@@ -531,7 +558,7 @@ def run_sync_sim(
                 dg, jnp.asarray(origins), jnp.asarray(gen_ticks), t_start,
                 last_gen, churn_dev, snap_ticks_dev,
                 chunk_size=chunk_size, horizon=horizon_ticks, block=block,
-                loss=loss_cfg,
+                loss=loss_cfg, use_pallas_tick=use_pallas_tick,
             )
             received += np.asarray(r, dtype=np.int64)
             sent += np.asarray(s, dtype=np.int64)
@@ -586,7 +613,7 @@ def run_flood_coverage(
     # Gate on where the graph actually lives (tests pin data to host CPU
     # even though a TPU plugin is registered) and on the kernel's validated
     # row bound (ops/pallas_kernels.py PALLAS_COVERAGE_MAX_ROWS).
-    from p2p_gossip_tpu.ops.pallas_kernels import coverage_rows_ok
+    from p2p_gossip_tpu.ops.pallas_kernels import coverage_rows_ok, tick_rows_ok
 
     on_tpu = any(d.platform == "tpu" for d in dg.ell_idx.devices())
     use_pallas = on_tpu and coverage_rows_ok(dg.n)
@@ -595,12 +622,14 @@ def run_flood_coverage(
             f"coverage: Pallas kernel demoted to the XLA path (N={dg.n} "
             "exceeds PALLAS_COVERAGE_MAX_ROWS)"
         )
+    use_pallas_tick = on_tpu and tick_rows_ok(dg.n)
     churn_dev = churn_to_device(churn)
     loss_cfg = loss.static_cfg if loss is not None else None
     _, r, snt, cov = _run_chunk_coverage(
         dg, jnp.asarray(o), jnp.asarray(g), churn_dev,
         chunk_size=chunk_size, horizon=horizon_ticks, block=block,
         use_pallas=use_pallas, coverage_slots=s, loss=loss_cfg,
+        use_pallas_tick=use_pallas_tick,
     )
     generated = effective_generated(sched, horizon_ticks, churn)
     received = np.asarray(r, dtype=np.int64)

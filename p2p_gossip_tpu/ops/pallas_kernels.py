@@ -44,23 +44,44 @@ DEFAULT_ROW_TILE = 256
 PALLAS_COVERAGE_MAX_ROWS = 100_000
 
 
-def coverage_rows_ok(n_rows: int) -> bool:
-    """Whether the coverage kernel should be used for ``n_rows`` (see
-    PALLAS_COVERAGE_MAX_ROWS)."""
+# Row bound for the fused tick-update kernel on real TPU (env override
+# P2P_PALLAS_TICK_MAX_ROWS; 0 disables). Starts at 0 — the kernel is
+# parity-tested in interpret mode but not yet validated on hardware; the
+# kernel bake-off (scripts/kernel_bench.py) validates and this constant
+# records the validated size.
+PALLAS_TICK_MAX_ROWS = 0
+
+
+def _rows_ok(n_rows: int, env_name: str, default_limit: int) -> bool:
+    """Shared row-bound gate for hardware-validated kernel sizes."""
     import os
     import warnings
 
-    raw = os.environ.get("P2P_PALLAS_COVERAGE_MAX_ROWS")
-    limit = PALLAS_COVERAGE_MAX_ROWS
+    raw = os.environ.get(env_name)
+    limit = default_limit
     if raw is not None:
         try:
             limit = int(raw)
         except ValueError:
             warnings.warn(
-                f"P2P_PALLAS_COVERAGE_MAX_ROWS={raw!r} is not an integer; "
-                f"using the default {PALLAS_COVERAGE_MAX_ROWS}"
+                f"{env_name}={raw!r} is not an integer; "
+                f"using the default {default_limit}"
             )
     return 0 < n_rows <= limit
+
+
+def coverage_rows_ok(n_rows: int) -> bool:
+    """Whether the coverage kernel should be used for ``n_rows`` (see
+    PALLAS_COVERAGE_MAX_ROWS)."""
+    return _rows_ok(
+        n_rows, "P2P_PALLAS_COVERAGE_MAX_ROWS", PALLAS_COVERAGE_MAX_ROWS
+    )
+
+
+def tick_rows_ok(n_rows: int) -> bool:
+    """Whether the fused tick-update kernel should be used for ``n_rows``
+    (see PALLAS_TICK_MAX_ROWS)."""
+    return _rows_ok(n_rows, "P2P_PALLAS_TICK_MAX_ROWS", PALLAS_TICK_MAX_ROWS)
 
 
 def _coverage_kernel(seen_ref, acc_ref):
@@ -114,6 +135,77 @@ def coverage_per_slot_pallas(
     )(seen)
     # acc[b, w] = count of slot w*32+b -> transpose to slot-major.
     return acc.T.reshape(w * WORD_BITS)[:n_slots]
+
+
+def _tick_update_kernel(
+    arrivals_ref, seen_ref, gen_ref, seen_out_ref, newly_out_ref, cnt_ref
+):
+    """The fused tick update (engine.sync.apply_tick_updates' bitmask
+    stage) on one VMEM-resident row tile:
+
+        newly     = arrivals & ~seen
+        seen'     = seen | arrivals | gen_bits
+        newly_out = newly | gen_bits        (next delay-line slot)
+        cnt       = popcount_rows(newly)    (first-time receives)
+
+    One HBM pass — 3 tile reads, 2 tile writes + an (N, 1) count — where
+    the unfused XLA graph materializes `newly`, `seen'`, and `newly_out`
+    as separate kernels re-reading their inputs (~8 reads / 3 writes).
+    """
+    arr = arrivals_ref[:]
+    sn = seen_ref[:]
+    gb = gen_ref[:]
+    newly = arr & ~sn
+    seen_out_ref[:] = sn | arr | gb
+    newly_out_ref[:] = newly | gb
+    cnt_ref[:] = jnp.sum(
+        jax.lax.population_count(newly).astype(jnp.int32),
+        axis=1, keepdims=True,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("row_tile", "interpret"))
+def tick_update_pallas(
+    arrivals: jnp.ndarray,  # (N, W) uint32
+    seen: jnp.ndarray,      # (N, W) uint32
+    gen_bits: jnp.ndarray,  # (N, W) uint32
+    row_tile: int = DEFAULT_ROW_TILE,
+    interpret: bool = False,
+):
+    """Fused bitmask tick update: returns (seen', newly_out, newly_cnt).
+
+    Bitwise-identical to the jnp formulation in
+    `engine.sync.apply_tick_updates` (the parity tests assert exactly
+    this); the counter arithmetic (received/sent) stays outside — it is
+    (N,)-sized and free."""
+    n, w = seen.shape
+    pad = (-n) % row_tile
+    if pad:
+        arrivals = jnp.pad(arrivals, ((0, pad), (0, 0)))
+        seen = jnp.pad(seen, ((0, pad), (0, 0)))
+        gen_bits = jnp.pad(gen_bits, ((0, pad), (0, 0)))
+    n_padded = seen.shape[0]
+    grid = (n_padded // row_tile,)
+    tile = lambda: pl.BlockSpec(  # noqa: E731
+        (row_tile, w), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    seen_out, newly_out, cnt = pl.pallas_call(
+        _tick_update_kernel,
+        grid=grid,
+        in_specs=[tile(), tile(), tile()],
+        out_specs=(
+            tile(),
+            tile(),
+            pl.BlockSpec((row_tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((n_padded, w), jnp.uint32),
+            jax.ShapeDtypeStruct((n_padded, w), jnp.uint32),
+            jax.ShapeDtypeStruct((n_padded, 1), jnp.int32),
+        ),
+        interpret=interpret,
+    )(arrivals, seen, gen_bits)
+    return seen_out[:n], newly_out[:n], cnt[:n, 0]
 
 
 def _popcount_rows_kernel(words_ref, out_ref):
