@@ -137,6 +137,25 @@ class DeviceGraph:
             buckets=buckets,
         )
 
+    def hbm_bytes_per_tick(self, w: int) -> int:
+        """Modeled HBM traffic of one tick at W words per row — the
+        roofline denominator for bench.py (bytes moved / wall vs the
+        chip's peak bandwidth). Counts the gather's frontier-row and
+        index reads over the STAGED (padded) ELL entries plus the
+        elementwise tick passes (arrivals materialization, the fused-or-
+        not seen/newly update, and the hist slot write ≈ 6 (N, W)
+        passes). A model, not a measurement: real traffic differs by
+        cache hits on repeated frontier rows and XLA fusion choices."""
+        if self.buckets is not None:
+            entries = sum(
+                int(b[1].shape[0]) * int(b[1].shape[1]) for b in self.buckets
+            )
+        else:
+            entries = int(self.ell_idx.shape[0]) * int(self.ell_idx.shape[1])
+        gather = entries * (w * 4 + 4)  # frontier row + int32 index
+        elementwise = 6 * self.n * w * 4
+        return gather + elementwise
+
 
 def _resolve_block(dg: DeviceGraph, block: int | None) -> int:
     """``block=None`` means auto: the swept TPU optimum capped by the staged
@@ -360,7 +379,10 @@ def _run_chunk_while(
     if k:
         # Boundaries at/after quiescence see the (unchanging) final counts.
         snaps = jnp.where((snap_ticks >= t)[:, None], received[None, :], snaps)
-    return seen, received, sent, snaps
+    # t - t_start = ticks actually executed (quiescence can stop well
+    # before the horizon) — the roofline accounting in bench.py divides
+    # measured wall time by this.
+    return seen, received, sent, snaps, t - t_start
 
 
 @functools.partial(
@@ -510,6 +532,7 @@ def run_sync_sim(
     )
     received = np.zeros(graph.n, dtype=np.int64)
     sent = np.zeros(graph.n, dtype=np.int64)
+    ticks_executed = 0
 
     checkpointer = None
     if checkpoint_path is not None:
@@ -554,7 +577,7 @@ def run_sync_sim(
                 )
             t_start = jnp.asarray(first_t, dtype=jnp.int32)
             last_gen = jnp.asarray(last_t, dtype=jnp.int32)
-            _, r, s, snaps = _run_chunk_while(
+            _, r, s, snaps, t_run = _run_chunk_while(
                 dg, jnp.asarray(origins), jnp.asarray(gen_ticks), t_start,
                 last_gen, churn_dev, snap_ticks_dev,
                 chunk_size=chunk_size, horizon=horizon_ticks, block=block,
@@ -562,6 +585,7 @@ def run_sync_sim(
             )
             received += np.asarray(r, dtype=np.int64)
             sent += np.asarray(s, dtype=np.int64)
+            ticks_executed += int(t_run)
             if boundaries:
                 snap_received += np.asarray(snaps, dtype=np.int64)
 
@@ -577,6 +601,7 @@ def run_sync_sim(
         processed=generated + received,
         degree=degree,
     )
+    stats.extra["ticks_executed"] = ticks_executed
     if snapshot_ticks is not None:
         # Present (possibly empty) whenever snapshots were requested, like
         # the event engines.
