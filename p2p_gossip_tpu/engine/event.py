@@ -45,6 +45,7 @@ def run_event_sim(
     snapshot_ticks: list[int] | None = None,
     churn=None,
     loss=None,
+    record_messages: bool = False,
 ) -> NodeStats:
     """Run the event-driven gossip simulation for ``horizon_ticks`` ticks.
 
@@ -64,6 +65,14 @@ def run_event_sim(
 
     Returns per-node counters; if ``coverage_slots`` is set, also records each
     listed share's first-arrival tick per node in ``stats.extra``.
+
+    ``record_messages`` captures every transmitted message as
+    ``stats.extra["messages"]`` — a list of (src, dst, share, tx_tick,
+    rx_tick, outcome) with outcome in {"delivered", "duplicate", "down",
+    "lost", "horizon"} — the per-packet record the reference gets from
+    NetAnim's ``EnablePacketMetadata`` (p2pnetwork.cc:187), here exact
+    rather than pcap-level. O(messages) memory: use at visualization
+    scale, not at 1M nodes.
     """
     n = graph.n
     indptr, indices = graph.indptr, graph.indices
@@ -91,6 +100,10 @@ def run_event_sim(
     # (all same-tick arrivals of a share are dropped after the first).
     heap: list[tuple[int, int, int, int, int]] = []
     seq = 0
+    # Per-message records (record_messages): row = [src, dst, share, tx,
+    # rx, outcome]; in-flight messages are found again at delivery by seq.
+    messages: list[list] = []
+    msg_by_seq: dict[int, int] = {}
     for s in range(schedule.num_shares):
         t = int(schedule.gen_ticks[s])
         if t < horizon_ticks:
@@ -115,11 +128,19 @@ def run_event_sim(
             )
         for k, e in enumerate(range(lo, hi)):
             t_arr = now + int(csr_delays[e])
+            dst = int(indices[e])
             if t_arr >= horizon_ticks:
+                if record_messages:
+                    messages.append([node, dst, share, now, t_arr, "horizon"])
                 continue
             if loss is not None and dropped[k]:
+                if record_messages:
+                    messages.append([node, dst, share, now, t_arr, "lost"])
                 continue
-            heapq.heappush(heap, (t_arr, seq, 1, int(indices[e]), share))
+            if record_messages:
+                msg_by_seq[seq] = len(messages)
+                messages.append([node, dst, share, now, t_arr, "delivered"])
+            heapq.heappush(heap, (t_arr, seq, 1, dst, share))
             seq += 1
 
     # Periodic-stats snapshots (PrintPeriodicStats, p2pnetwork.cc:231):
@@ -157,7 +178,7 @@ def run_event_sim(
             return not ((c_start[node] <= t) & (t < c_end[node])).any()
 
     while heap:
-        t, _, kind, node, share = heapq.heappop(heap)
+        t, ev_seq, kind, node, share = heapq.heappop(heap)
         take_snapshots(t)
         events_processed += 1
         if churn is not None and not is_up(node, t):
@@ -167,6 +188,8 @@ def run_event_sim(
                     + ("generation skipped" if kind == 0 else "share lost"),
                     sim_time=t,
                 )
+            if record_messages and kind == 1:
+                messages[msg_by_seq[ev_seq]][5] = "down"
             continue
         if kind == 0:
             generated[node] += 1
@@ -182,6 +205,8 @@ def run_event_sim(
                     log.logic(
                         f"Node {node} dropped duplicate share {share}", sim_time=t
                     )
+                if record_messages:
+                    messages[msg_by_seq[ev_seq]][5] = "duplicate"
                 continue
             seen[node].add(share)
             received[node] += 1
@@ -212,6 +237,8 @@ def run_event_sim(
         stats.extra["snapshots"] = snapshots
     if arrival_ticks is not None:
         stats.extra["arrival_ticks"] = arrival_ticks
+    if record_messages:
+        stats.extra["messages"] = [tuple(m) for m in messages]
     return stats
 
 

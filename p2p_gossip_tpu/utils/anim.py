@@ -36,9 +36,19 @@ def write_animation_xml(
     path: str,
     coverage: np.ndarray | None = None,
     tick_dt: float = 1.0,
+    messages=None,
 ) -> None:
     """Write a NetAnim-style XML trace (reference default file name:
-    ``p2p-gossip-tcp-animation.xml``)."""
+    ``p2p-gossip-tcp-animation.xml``).
+
+    ``messages`` embeds per-message packet events — the analogue of
+    NetAnim's ``EnablePacketMetadata`` (p2pnetwork.cc:187) — as one
+    ``<p>`` element per transmission, mirroring NetAnim's packet schema
+    (fId/tId sender/receiver, fbTx/fbRx first-bit times) plus the share
+    id and the exact outcome (delivered / duplicate-dropped / lost on the
+    link / receiver down / past horizon), which pcap-level metadata can't
+    express. Takes the (src, dst, share, tx_tick, rx_tick, outcome)
+    tuples from ``run_event_sim(record_messages=True)``."""
     pos = _grid_positions(graph.n)
     lines = ['<?xml version="1.0" encoding="UTF-8"?>', '<anim ver="netanim-3.108">']
     for i in range(graph.n):
@@ -56,6 +66,13 @@ def write_animation_xml(
             counts = ",".join(str(int(c)) for c in coverage[t])
             lines.append(
                 f'<coverage t="{t * tick_dt:.6g}" counts="{counts}"/>'
+            )
+    if messages is not None:
+        for src, dst, share, tx, rx, outcome in messages:
+            lines.append(
+                f'<p fId="{int(src)}" tId="{int(dst)}" '
+                f'fbTx="{tx * tick_dt:.6g}" fbRx="{rx * tick_dt:.6g}" '
+                f'share="{int(share)}" outcome="{outcome}"/>'
             )
     lines.append("</anim>")
     with open(path, "w") as f:

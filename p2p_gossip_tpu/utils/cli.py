@@ -167,6 +167,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="Write a NetAnim-style XML trace to this path",
     )
     p.add_argument(
+        "--animMessages", action="store_true",
+        help="Embed per-message packet events in the --anim trace "
+        "(EnablePacketMetadata analogue; event backend + push protocol "
+        "only — the exact per-message path)",
+    )
+    p.add_argument(
         "--perNodeStats", action="store_true", default=None,
         help="Print per-node lines (default: on for N <= 1000)",
     )
@@ -627,6 +633,16 @@ def run(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.animMessages and not (
+        args.anim and args.backend == "event" and args.protocol == "push"
+    ):
+        print(
+            "error: --animMessages requires --anim with --backend event "
+            "and --protocol push (per-message recording lives in the "
+            "exact event path)",
+            file=sys.stderr,
+        )
+        return 2
     if args.checkpoint and args.backend not in ("tpu", "sharded"):
         print(
             "error: --checkpoint requires --backend tpu|sharded",
@@ -736,7 +752,7 @@ def run(argv=None) -> int:
 
         stats = run_event_sim(
             g, sched, horizon, ell_delays=delays, snapshot_ticks=snapshot_ticks,
-            churn=churn, loss=loss,
+            churn=churn, loss=loss, record_messages=args.animMessages,
         )
     wall = time.perf_counter() - t0
 
@@ -785,7 +801,10 @@ def run(argv=None) -> int:
     if args.anim:
         from p2p_gossip_tpu.utils.anim import write_animation_xml
 
-        write_animation_xml(g, args.anim)
+        write_animation_xml(
+            g, args.anim, tick_dt=tick_dt,
+            messages=stats.extra.get("messages"),
+        )
         print(f"NetAnim trace written to {args.anim}")
     return 0
 

@@ -320,3 +320,27 @@ def test_serialization_delay_model_cli():
     assert node_lines(ev.stdout) == node_lines(tp.stdout)
     bad = _run_cli(*common[:-2], "--shareBytes", "-1", "--backend", "event")
     assert bad.returncode == 2 and "error:" in bad.stderr
+
+
+def test_anim_messages_flag(tmp_path, capsys):
+    """--animMessages embeds per-message <p> events; invalid combos get
+    the clean-error convention."""
+    from p2p_gossip_tpu.utils.cli import run
+
+    out = tmp_path / "a.xml"
+    rc = run([
+        "--numNodes", "12", "--connectionProb", "0.3", "--simTime", "5",
+        "--Latency", "5", "--backend", "event", "--seed", "2",
+        "--anim", str(out), "--animMessages",
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    text = out.read_text()
+    assert '<p fId="' in text and 'outcome="delivered"' in text
+
+    rc = run([
+        "--numNodes", "12", "--backend", "tpu", "--anim", str(out),
+        "--animMessages",
+    ])
+    assert rc == 2
+    assert "--animMessages requires" in capsys.readouterr().err

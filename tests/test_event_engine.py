@@ -113,3 +113,41 @@ def test_final_statistics_format():
     assert "Node 0: Generated 1, Received 0, Forwarded 0" in text
     assert "Total shares generated: 1" in text
     assert text.count("Peer count 2") == 3
+
+
+def test_record_messages_accounts_for_every_send():
+    """Per-message records (EnablePacketMetadata analogue): one record per
+    charged send, outcomes partitioning exactly into the counter
+    identities."""
+    import collections
+
+    import p2p_gossip_tpu as pg
+
+    g = erdos_renyi(40, 0.15, seed=8)
+    sched = pg.uniform_renewal_schedule(40, sim_time=4.0, tick_dt=0.01, seed=8)
+    loss = pg.LinkLossModel(0.2, seed=3)
+    churn = pg.random_churn(40, 400, outage_prob=0.3, mean_down_ticks=30, seed=4)
+    stats = run_event_sim(
+        g, sched, 400, loss=loss, churn=churn, record_messages=True
+    )
+    msgs = stats.extra["messages"]
+    by_outcome = collections.Counter(m[5] for m in msgs)
+    # Every send the counters charged has exactly one record.
+    assert len(msgs) == int(stats.sent.sum())
+    # Delivered records are exactly the first-time receives.
+    assert by_outcome["delivered"] == int(stats.received.sum())
+    assert set(by_outcome) <= {"delivered", "duplicate", "down", "lost", "horizon"}
+    # Under 20% loss + churn these outcomes must actually occur.
+    assert by_outcome["lost"] > 0 and by_outcome["duplicate"] > 0
+    for src, dst, share, tx, rx, outcome in msgs:
+        assert 0 <= src < 40 and 0 <= dst < 40
+        assert rx > tx  # delay >= 1 tick
+
+
+def test_record_messages_off_by_default():
+    import p2p_gossip_tpu as pg
+
+    g = erdos_renyi(20, 0.2, seed=1)
+    sched = pg.uniform_renewal_schedule(20, sim_time=2.0, tick_dt=0.01, seed=1)
+    stats = run_event_sim(g, sched, 200)
+    assert "messages" not in stats.extra
