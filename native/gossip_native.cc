@@ -101,7 +101,7 @@ extern "C" {
 // Bump whenever any exported signature changes. runtime/native.py refuses a
 // library whose version doesn't match (a stale .so bound with the wrong
 // argument layout would corrupt memory) and falls back to the Python engine.
-int64_t gossip_abi_version() { return 5; }
+int64_t gossip_abi_version() { return 6; }
 
 // Runs the event-driven simulation. Returns the number of events processed
 // (heap pops), the metric NS-3-style engines are measured by. Snapshot
@@ -118,10 +118,14 @@ int64_t gossip_abi_version() { return 5; }
 // Link loss (models/linkloss.py semantics): loss_threshold > 0 enables the
 // per-link erasure coin above; a dropped message never enters the heap (the
 // sender's `sent` already counted it).
+//
+// connect_tick models the reference's socket warm-up window
+// (p2pnetwork.cc:93-96): a broadcast before it finds no sockets — nothing
+// sent, nothing charged (p2pnode.cc:131-135). 0 = connected from t0.
 int64_t gossip_run_event_sim(
     int64_t n, const int64_t* indptr, const int32_t* indices,
     const int32_t* csr_delays, int64_t num_shares, const int32_t* origins,
-    const int32_t* gen_ticks, int64_t horizon,
+    const int32_t* gen_ticks, int64_t horizon, int64_t connect_tick,
     int64_t churn_k, const int32_t* churn_start, const int32_t* churn_end,
     int64_t loss_threshold, int64_t loss_seed,
     int64_t num_snapshots, const int64_t* snapshot_ticks,
@@ -153,6 +157,7 @@ int64_t gossip_run_event_sim(
 
   const uint32_t lseed = static_cast<uint32_t>(loss_seed);
   auto broadcast = [&](int64_t node, int64_t share, int64_t now) {
+    if (now < connect_tick) return;  // warm-up: no sockets, no charge
     const int64_t lo = indptr[node], hi = indptr[node + 1];
     out_sent[node] += hi - lo;
     for (int64_t e = lo; e < hi; ++e) {

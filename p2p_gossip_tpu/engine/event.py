@@ -46,6 +46,7 @@ def run_event_sim(
     churn=None,
     loss=None,
     record_messages: bool = False,
+    connect_tick: int = 0,
 ) -> NodeStats:
     """Run the event-driven gossip simulation for ``horizon_ticks`` ticks.
 
@@ -65,6 +66,15 @@ def run_event_sim(
 
     Returns per-node counters; if ``coverage_slots`` is set, also records each
     listed share's first-arrival tick per node in ``stats.extra``.
+
+    ``connect_tick`` models the reference's socket warm-up window
+    (peers connect at t=5 s, p2pnetwork.cc:93-96, while generation can
+    start earlier): before it, a broadcast finds no sockets — nothing is
+    sent and no ``sent`` is charged (GossipShareToPeers skips missing
+    sockets without counting, p2pnode.cc:131-135) — so shares generated
+    pre-connect stay with their origin forever. 0 (default) =
+    connected-from-t0, the rebuild's base semantics (SURVEY §1
+    deviation 2).
 
     ``record_messages`` captures every transmitted message as
     ``stats.extra["messages"]`` — a list of (src, dst, share, tx_tick,
@@ -118,6 +128,10 @@ def run_event_sim(
 
     def broadcast(node: int, share: int, now: int) -> None:
         nonlocal seq
+        if now < connect_tick:
+            # Warm-up window: no sockets yet — nothing sent, nothing
+            # charged (p2pnode.cc:131-135).
+            return
         lo, hi = indptr[node], indptr[node + 1]
         sent[node] += hi - lo
         if loss is not None:

@@ -266,7 +266,7 @@ def apply_tick_updates(
 
 def _tick_body(
     dg: DeviceGraph, block: int, state, origins, slots, gen_ticks, churn=None,
-    loss=None, use_pallas_tick: bool = False,
+    loss=None, use_pallas_tick: bool = False, connect_tick: int = 0,
 ):
     """One synchronous tick. state = (t, seen, hist, received, sent).
 
@@ -309,10 +309,25 @@ def _tick_body(
         .at[origins]
         .add(gen_active.astype(jnp.int32))
     )
-    seen, newly_out, received, sent = apply_tick_updates(
-        seen, arrivals, gen_bits, gen_cnt, received, sent, dg.degree,
-        use_pallas=use_pallas_tick,
-    )
+    if connect_tick:
+        # Socket warm-up window (p2pnetwork.cc:93-96): a whole tick is
+        # either pre- or post-connect. Pre-connect generations enter the
+        # origin's seen-set (generated++ happens host-side) but are never
+        # broadcast — no frontier contribution, no `sent` charge
+        # (GossipShareToPeers skips missing sockets, p2pnode.cc:131-135).
+        pre = t < connect_tick
+        live_bits = jnp.where(pre, jnp.uint32(0), gen_bits)
+        live_cnt = jnp.where(pre, 0, gen_cnt)
+        seen, newly_out, received, sent = apply_tick_updates(
+            seen, arrivals, live_bits, live_cnt, received, sent, dg.degree,
+            use_pallas=use_pallas_tick,
+        )
+        seen = seen | jnp.where(pre, gen_bits, jnp.uint32(0))
+    else:
+        seen, newly_out, received, sent = apply_tick_updates(
+            seen, arrivals, gen_bits, gen_cnt, received, sent, dg.degree,
+            use_pallas=use_pallas_tick,
+        )
     hist = hist.at[jnp.mod(t, dg.ring_size)].set(newly_out)
     return (t + 1, seen, hist, received, sent)
 
@@ -321,6 +336,7 @@ def _tick_body(
     jax.jit,
     static_argnames=(
         "chunk_size", "horizon", "block", "loss", "use_pallas_tick",
+        "connect_tick",
     ),
 )
 def _run_chunk_while(
@@ -337,6 +353,7 @@ def _run_chunk_while(
     block: int,
     loss: tuple | None = None,
     use_pallas_tick: bool = False,
+    connect_tick: int = 0,
 ):
     """Run one share chunk to quiescence (or the horizon) under while_loop.
 
@@ -371,7 +388,7 @@ def _run_chunk_while(
             )
         t, seen, hist, received, sent = _tick_body(
             dg, block, (t, seen, hist, received, sent), origins, slots,
-            gen_ticks, churn, loss, use_pallas_tick,
+            gen_ticks, churn, loss, use_pallas_tick, connect_tick,
         )
         return (t, seen, hist, received, sent, snaps)
 
@@ -476,6 +493,7 @@ def run_sync_sim(
     churn=None,
     snapshot_ticks: list[int] | None = None,
     loss=None,
+    connect_tick: int = 0,
 ) -> NodeStats:
     """Run the full simulation on the synchronous engine.
 
@@ -503,6 +521,10 @@ def run_sync_sim(
     crossing a directed link during one of its erasure ticks are dropped
     in flight (sender still counts the send). Deterministic — identical
     counters on the event engines under the same model.
+
+    ``connect_tick`` models the reference's socket warm-up window (see
+    run_event_sim): pre-connect generations are counted and marked seen
+    at their origin but never broadcast.
     """
     dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
     block = _resolve_block(dg, block)
@@ -553,6 +575,8 @@ def run_sync_sim(
             # Appended only when snapshots are on, so checkpoints from
             # snapshot-free runs keep their pre-existing fingerprints.
             *([np.asarray(boundaries, dtype=np.int64)] if boundaries else []),
+            # Warm-up window changes the results; appended only when on.
+            *(["connect", connect_tick] if connect_tick else []),
         )
         checkpointer = ChunkCheckpointer(
             checkpoint_path, ckpt_fp,
@@ -582,6 +606,7 @@ def run_sync_sim(
                 last_gen, churn_dev, snap_ticks_dev,
                 chunk_size=chunk_size, horizon=horizon_ticks, block=block,
                 loss=loss_cfg, use_pallas_tick=use_pallas_tick,
+                connect_tick=connect_tick,
             )
             received += np.asarray(r, dtype=np.int64)
             sent += np.asarray(s, dtype=np.int64)

@@ -251,6 +251,7 @@ def build_sharded_runner(
     cov_slots: int | None = None,
     ring_mode: str = "replicated",
     delay_values: tuple | None = None,
+    connect_tick: int = 0,
 ):
     """Compile the per-pass runner: each shares-shard processes its own
     ``chunk_size`` shares over the row-sharded graph, from the chunk's first
@@ -393,9 +394,21 @@ def build_sharded_runner(
                 .at[local_rows]
                 .add(gen_active.astype(jnp.int32), mode="drop")
             )
-            seen, newly_out, received, sent = apply_tick_updates(
-                seen, arrivals, gen_bits, gen_cnt, received, sent, degree
-            )
+            if connect_tick:
+                # Socket warm-up window (engine.sync._tick_body): the
+                # pre-connect generation enters seen only — no frontier,
+                # no sent charge.
+                pre = t < connect_tick
+                live_bits = jnp.where(pre, jnp.uint32(0), gen_bits)
+                live_cnt = jnp.where(pre, 0, gen_cnt)
+                seen, newly_out, received, sent = apply_tick_updates(
+                    seen, arrivals, live_bits, live_cnt, received, sent, degree
+                )
+                seen = seen | jnp.where(pre, gen_bits, jnp.uint32(0))
+            else:
+                seen, newly_out, received, sent = apply_tick_updates(
+                    seen, arrivals, gen_bits, gen_cnt, received, sent, degree
+                )
             if sharded_ring:
                 # Local write; the frontier exchange happens at READ time
                 # (read_slice), so per-chip ring HBM is n_loc rows.
@@ -473,6 +486,7 @@ def run_sharded_sim(
     checkpoint_every: int = 1,
     stop_after_chunks: int | None = None,
     ring_mode: str = "auto",
+    connect_tick: int = 0,
 ) -> NodeStats:
     """Drop-in counterpart of run_sync_sim/run_event_sim on a device mesh:
     identical per-node counters, any number of shares — including under a
@@ -516,6 +530,7 @@ def run_sharded_sim(
         len(boundaries),
         loss.static_cfg if loss is not None else None,
         ring_mode=ring_mode, delay_values=delay_values,
+        connect_tick=connect_tick,
     )
 
     received = np.zeros(n_padded, dtype=np.int64)
@@ -544,6 +559,8 @@ def run_sharded_sim(
             if loss is not None
             else None,
             *([np.asarray(boundaries, dtype=np.int64)] if boundaries else []),
+            # Warm-up window changes the results; appended only when on.
+            *(["connect", connect_tick] if connect_tick else []),
         )
         checkpointer = ChunkCheckpointer(
             checkpoint_path, ckpt_fp,

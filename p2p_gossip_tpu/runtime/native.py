@@ -31,7 +31,7 @@ _lib_checked = False
 # Must match gossip_abi_version() in native/gossip_native.cc. Binding a stale
 # .so with a different argument layout would scribble over the wrong buffers,
 # so a mismatch is treated as "not built".
-ABI_VERSION = 5
+ABI_VERSION = 6
 
 
 def _try_autobuild() -> None:
@@ -117,6 +117,7 @@ def _configure(lib) -> None:
         i32p,                        # origins
         i32p,                        # gen_ticks
         ctypes.c_int64,              # horizon
+        ctypes.c_int64,              # connect_tick (0 = connected at t0)
         ctypes.c_int64,              # churn_k
         i32p, i32p,                  # churn_start, churn_end (n x churn_k)
         ctypes.c_int64,              # loss_threshold (0 = off)
@@ -195,10 +196,12 @@ def run_native_sim(
     snapshot_ticks: list[int] | None = None,
     churn=None,
     loss=None,
+    connect_tick: int = 0,
 ) -> NodeStats:
     """Event-driven simulation on the C++ engine (counters identical to
-    `engine.event.run_event_sim`, including under churn and link-loss
-    models). Falls back to Python when unbuilt."""
+    `engine.event.run_event_sim`, including under churn, link-loss, and
+    the socket warm-up window ``connect_tick``). Falls back to Python
+    when unbuilt."""
     lib = load_library()
     if lib is None:
         warnings.warn(
@@ -209,6 +212,7 @@ def run_native_sim(
         return run_event_sim(
             graph, schedule, horizon_ticks, ell_delays, constant_delay,
             snapshot_ticks=snapshot_ticks, churn=churn, loss=loss,
+            connect_tick=connect_tick,
         )
 
     n = graph.n
@@ -239,6 +243,7 @@ def run_native_sim(
         origins,
         gen_ticks,
         horizon_ticks,
+        connect_tick,
         churn_k,
         churn_start,
         churn_end,

@@ -152,6 +152,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="Maximum outages per node over the run",
     )
     p.add_argument(
+        "--connectAtTick", type=int, default=0,
+        help="Socket warm-up window: peers connect at this tick "
+        "(reference: 5s, p2pnetwork.cc:93-96); shares generated earlier "
+        "stay with their origin and charge no sends. 0 = connected at t0",
+    )
+    p.add_argument(
         "--statsInterval", type=float, default=10.0,
         help="Periodic stats interval in seconds",
     )
@@ -590,6 +596,35 @@ def run(argv=None) -> int:
         # runs pushk too.
         print("error: --fanout must be >= 1", file=sys.stderr)
         return 2
+    # Validated before the --floodCoverage early return too — these flags
+    # must be rejected there, not silently ignored.
+    if args.connectAtTick < 0:
+        print(
+            f"error: --connectAtTick must be >= 0, got {args.connectAtTick}",
+            file=sys.stderr,
+        )
+        return 2
+    if args.connectAtTick and (args.protocol != "push" or args.floodCoverage):
+        print(
+            "error: --connectAtTick supports only --protocol push without "
+            "--floodCoverage (the warm-up window is a flood-gossip "
+            "reference semantic)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.animMessages and not (
+        args.anim
+        and args.backend == "event"
+        and args.protocol == "push"
+        and not args.floodCoverage
+    ):
+        print(
+            "error: --animMessages requires --anim with --backend event "
+            "and --protocol push (per-message recording lives in the "
+            "exact event path)",
+            file=sys.stderr,
+        )
+        return 2
 
     if args.floodCoverage:
         if args.json:
@@ -630,16 +665,6 @@ def run(argv=None) -> int:
             f"error: --protocol {args.protocol} --backend event supports "
             "only --delayModel constant (the numpy oracle is the "
             "one-tick-delay specification)",
-            file=sys.stderr,
-        )
-        return 2
-    if args.animMessages and not (
-        args.anim and args.backend == "event" and args.protocol == "push"
-    ):
-        print(
-            "error: --animMessages requires --anim with --backend event "
-            "and --protocol push (per-message recording lives in the "
-            "exact event path)",
             file=sys.stderr,
         )
         return 2
@@ -723,6 +748,7 @@ def run(argv=None) -> int:
             churn=churn,
             snapshot_ticks=snapshot_ticks,
             loss=loss,
+            connect_tick=args.connectAtTick,
         )
     elif args.backend == "sharded":
         from p2p_gossip_tpu.parallel.engine_sharded import run_sharded_sim
@@ -739,13 +765,14 @@ def run(argv=None) -> int:
             churn=churn, snapshot_ticks=snapshot_ticks, loss=loss,
             checkpoint_path=args.checkpoint or None,
             checkpoint_every=args.checkpointEvery,
+            connect_tick=args.connectAtTick,
         )
     elif args.backend == "native":
         from p2p_gossip_tpu.runtime.native import run_native_sim
 
         stats = run_native_sim(
             g, sched, horizon, ell_delays=delays, snapshot_ticks=snapshot_ticks,
-            churn=churn, loss=loss,
+            churn=churn, loss=loss, connect_tick=args.connectAtTick,
         )
     else:
         from p2p_gossip_tpu.engine.event import run_event_sim
@@ -753,6 +780,7 @@ def run(argv=None) -> int:
         stats = run_event_sim(
             g, sched, horizon, ell_delays=delays, snapshot_ticks=snapshot_ticks,
             churn=churn, loss=loss, record_messages=args.animMessages,
+            connect_tick=args.connectAtTick,
         )
     wall = time.perf_counter() - t0
 

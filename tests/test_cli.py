@@ -344,3 +344,48 @@ def test_anim_messages_flag(tmp_path, capsys):
     ])
     assert rc == 2
     assert "--animMessages requires" in capsys.readouterr().err
+
+
+def test_connect_at_tick_cli(capsys):
+    """--connectAtTick mirrors the reference's 5s warm-up: identical
+    output across backends, fewer sends than the connected-at-t0 run."""
+    from p2p_gossip_tpu.utils.cli import run
+
+    common = [
+        "--numNodes", "25", "--connectionProb", "0.2", "--simTime", "8",
+        "--Latency", "5", "--seed", "9",
+    ]
+    # Reference geometry: 5 ms ticks, connect at 5 s = tick 1000; use a
+    # smaller window so the run stays quick.
+    outs = {}
+    for backend in ("event", "tpu"):
+        rc = run(common + ["--backend", backend, "--connectAtTick", "600"])
+        out = capsys.readouterr().out
+        assert rc == 0, backend
+        outs[backend] = sorted(
+            l for l in out.splitlines() if l.startswith("Node ")
+        )
+    assert outs["event"] == outs["tpu"]
+
+    rc = run(common + ["--backend", "event", "--connectAtTick", "600",
+                       "--protocol", "pushpull"])
+    assert rc == 2
+    assert "--connectAtTick" in capsys.readouterr().err
+
+
+def test_connect_at_tick_rejected_on_flood_coverage_and_negative(capsys):
+    from p2p_gossip_tpu.utils.cli import run
+
+    rc = run([
+        "--numNodes", "20", "--floodCoverage", "4", "--connectAtTick", "600",
+    ])
+    assert rc == 2
+    assert "--connectAtTick" in capsys.readouterr().err
+    rc = run(["--numNodes", "20", "--connectAtTick", "-5"])
+    assert rc == 2
+    assert ">= 0" in capsys.readouterr().err
+    rc = run([
+        "--numNodes", "20", "--floodCoverage", "4", "--animMessages",
+        "--anim", "/tmp/x.xml", "--backend", "event",
+    ])
+    assert rc == 2

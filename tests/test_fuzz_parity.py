@@ -92,3 +92,50 @@ def test_three_engine_parity_random_config(seed):
                 "snapshots", []
             )
     ev.check_conservation()
+
+
+def test_connect_tick_warmup_parity_all_engines():
+    """Socket warm-up window (p2pnetwork.cc:93-96): pre-connect shares
+    stay with their origin; all four engines agree on the counters."""
+    import p2p_gossip_tpu as pg
+    from p2p_gossip_tpu.engine.event import run_event_sim
+    from p2p_gossip_tpu.engine.sync import run_sync_sim
+    from p2p_gossip_tpu.parallel.engine_sharded import run_sharded_sim
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+    from p2p_gossip_tpu.runtime import native
+
+    import jax
+
+    g = pg.erdos_renyi(50, 0.12, seed=13)
+    # Generation window [0, 400) with connect at 150: a solid fraction of
+    # shares land pre-connect.
+    sched = pg.uniform_renewal_schedule(
+        50, sim_time=4.0, tick_dt=0.01, lo=0.5, hi=4.0, seed=13
+    )
+    connect = 150
+    ev = run_event_sim(g, sched, 400, connect_tick=connect)
+    sy = run_sync_sim(g, sched, 400, chunk_size=32, connect_tick=connect)
+    assert sy.equal_counts(ev)
+    mesh = make_mesh(4, 2, devices=jax.devices("cpu"))
+    sh = run_sharded_sim(
+        g, sched, 400, mesh, chunk_size=32, connect_tick=connect
+    )
+    assert sh.equal_counts(ev)
+    if native.available():
+        nv = native.run_native_sim(g, sched, 400, connect_tick=connect)
+        assert nv.equal_counts(ev)
+
+    # Semantics: pre-connect shares never spread and charge no sends.
+    pre = sched.gen_ticks < connect
+    assert pre.any() and (~pre).any(), "window must split the schedule"
+    baseline = run_event_sim(g, sched, 400)
+    assert int(ev.received.sum()) < int(baseline.received.sum())
+    # Modified conservation law: only post-connect generations broadcast,
+    # so sent == (generated_post_connect + forwarded) * degree per node.
+    gen_post = np.bincount(
+        sched.origins[~pre], minlength=g.n
+    ).astype(np.int64)
+    assert (ev.sent == (gen_post + ev.forwarded) * ev.degree).all()
+    # processed == generated + received still holds (pre-connect shares
+    # count as generated+processed at their origin).
+    assert (ev.processed == ev.generated + ev.received).all()
