@@ -18,6 +18,23 @@ Collectives per round, riding ICI:
 - the state **exchange**: each shard all_gathers its updated local state
   (seen for push-pull, newly-frontier for fanout push) into the global
   history ring that next round's delay-line reads index.
+
+Like the flood engine, the history ring has a ``ring_mode``:
+
+- ``"replicated"`` — full (ring, N, W) ring per chip, write-time
+  all_gather, local reads (above);
+- ``"sharded"`` — per-chip (ring, N/shards, W). Fanout push reads only
+  its OWN rows' past frontiers, so the sharded ring drops the exchange
+  all_gather entirely — strictly less ICI traffic AND less HBM. The
+  anti-entropy protocols read the PARTNER's past state: the sharded ring
+  reconstructs the (t − d) global slice per distinct delay value d at
+  read time (one all_gather each; exactly one for the constant-delay
+  default) and selects each node's partner row from the slice matching
+  its edge delay.
+
+``"auto"`` picks sharded for fanout push always, for anti-entropy under
+uniform delay (same traffic, 1/shards HBM), and otherwise replicated
+until the ring would exceed RING_REPLICATED_MAX_BYTES per chip.
 """
 
 from __future__ import annotations
@@ -69,6 +86,8 @@ def build_partnered_runner(
     fanout: int = 1,
     loss: tuple | None = None,
     record_coverage: bool = False,
+    ring_mode: str = "replicated",
+    delay_values: tuple | None = None,
 ):
     """Compile the per-pass runner for a random-partner protocol over the
     mesh. Memoized on mesh/shapes like engine_sharded.build_sharded_runner.
@@ -89,6 +108,8 @@ def build_partnered_runner(
     # of seen-states); "pull" skips the push direction and credits `sent`
     # to the responder (see run_pushpull_sim's mode="pull" docs).
     anti = protocol in ("pushpull", "pull")
+    sharded_ring = ring_mode == "sharded"
+    hist_rows = (n_padded // n_node_shards) if sharded_ring else n_padded
 
     def pass_fn(
         ell_idx, ell_delay, degree, churn_start, churn_end,
@@ -105,7 +126,9 @@ def build_partnered_runner(
 
         state = (
             jnp.zeros((n_loc, w), dtype=jnp.uint32),              # seen
-            jnp.zeros((ring_size, n_padded, w), dtype=jnp.uint32),  # hist
+            # History ring: global rows (replicated) or this shard's rows
+            # only (sharded — read_slice reassembles what's needed).
+            jnp.zeros((ring_size, hist_rows, w), dtype=jnp.uint32),
             jnp.zeros((n_loc,), dtype=jnp.int32),                 # received
             jnp.zeros((n_loc,), dtype=jnp.uint32),                # sent lo
             jnp.zeros((n_loc,), dtype=jnp.uint32),                # sent hi
@@ -131,13 +154,33 @@ def build_partnered_runner(
                 partners = ell_idx[rows_l[:, None], kidx]  # (n_loc, k)
                 delay = ell_delay[rows_l[:, None], kidx]
 
-            flat = hist.reshape(ring_size * n_padded, w)
             slot = jnp.mod(t - delay, ring_size)
-            if anti:
-                remote = flat[slot * n_padded + partners]          # pull
-                my_old = flat[slot * n_padded + node_ids]          # push
+            if sharded_ring:
+                # Own-row reads are local in the sharded layout.
+                loc_flat = hist.reshape(ring_size * hist_rows, w)
+                if anti:
+                    my_old = loc_flat[slot * hist_rows + rows_l]
+                    # Partner state: reassemble the (t - d) global slice
+                    # per distinct delay value and select each node's
+                    # partner row from the slice its edge dictates.
+                    remote = jnp.zeros((n_loc, w), dtype=jnp.uint32)
+                    for dval in delay_values:
+                        f_d = lax.all_gather(
+                            hist[jnp.mod(t - dval, ring_size)],
+                            NODES_AXIS, axis=0, tiled=True,
+                        )
+                        remote = jnp.where(
+                            (delay == dval)[:, None], f_d[partners], remote
+                        )
+                else:
+                    my_old = loc_flat[slot * hist_rows + rows_l[:, None]]
             else:
-                my_old = flat[slot * n_padded + node_ids[:, None]]  # (n_loc,k,W)
+                flat = hist.reshape(ring_size * n_padded, w)
+                if anti:
+                    remote = flat[slot * n_padded + partners]          # pull
+                    my_old = flat[slot * n_padded + node_ids]          # push
+                else:
+                    my_old = flat[slot * n_padded + node_ids[:, None]]  # (n_loc,k,W)
 
             up = up_mask_jnp(churn_start, churn_end, t)   # (n_padded,)
             self_ids = node_ids if anti else node_ids[:, None]
@@ -222,8 +265,13 @@ def build_partnered_runner(
                 received = received + bitmask.popcount_rows(newly)
                 seen = seen | newly | gen_bits
                 exchange = newly | gen_bits           # hist holds frontier
-            full = lax.all_gather(exchange, NODES_AXIS, axis=0, tiled=True)
-            hist = hist.at[jnp.mod(t, ring_size)].set(full)
+            if sharded_ring:
+                # Local write; reads reassemble at read time (or stay
+                # local entirely for fanout push).
+                hist = hist.at[jnp.mod(t, ring_size)].set(exchange)
+            else:
+                full = lax.all_gather(exchange, NODES_AXIS, axis=0, tiled=True)
+                hist = hist.at[jnp.mod(t, ring_size)].set(full)
             if record_coverage:
                 cov = lax.psum(
                     bitmask.coverage_per_slot(seen, chunk_size), NODES_AXIS
@@ -281,6 +329,7 @@ def run_sharded_partnered_sim(
     checkpoint_path: str | None = None,
     checkpoint_every: int = 1,
     stop_after_chunks: int | None = None,
+    ring_mode: str = "auto",
 ):
     """Drop-in counterpart of run_pushpull_sim / run_pushk_sim on a device
     mesh: identical per-node counters for any mesh shape (the counter-based
@@ -316,11 +365,33 @@ def run_sharded_partnered_sim(
     n_padded = ell_idx.shape[0]
     churn_start, churn_end = _padded_churn(churn, n_padded, n_node_shards)
 
+    # Ring layout (module docstring). The distinct-delay set comes from
+    # the padded ELL delay array — a superset of the valid entries (row
+    # padding fills with 1), which costs at most one spare slice
+    # all_gather per round and can never miss a real delay.
+    from p2p_gossip_tpu.parallel.engine_sharded import resolve_ring_mode
+
+    distinct = tuple(int(v) for v in np.unique(ell_delays))
+    if ring_mode == "auto" and protocol == "pushk":
+        # Fanout push reads only its own rows' history: the sharded ring
+        # drops the exchange all_gather outright.
+        ring_mode = "sharded"
+    ring_mode, ring_bytes = resolve_ring_mode(
+        ring_mode, distinct[0] if len(distinct) == 1 else None,
+        ring, n_padded, n_node_shards, bitmask.num_words(chunk_size),
+    )
+    delay_values = (
+        distinct
+        if ring_mode == "sharded" and protocol in ("pushpull", "pull")
+        else None
+    )
+
     runner, pass_size = build_partnered_runner(
         mesh, protocol, n_padded, ring, chunk_size, horizon_ticks,
         fanout if protocol == "pushk" else 1,
         loss.static_cfg if loss is not None else None,
         record_coverage,
+        ring_mode=ring_mode, delay_values=delay_values,
     )
     seed_arr = np.uint32(seed & 0xFFFFFFFF)
     n_share_shards = mesh.shape[SHARES_AXIS]
@@ -386,6 +457,12 @@ def run_sharded_partnered_sim(
         processed=generated + received,
         degree=graph.degree.astype(np.int64),
     )
+    stats.extra["ring"] = {
+        "mode": ring_mode,
+        "bytes_per_chip": ring_bytes,
+        "slots": ring,
+        "delay_splits": len(delay_values) if delay_values else 1,
+    }
     if record_coverage:
         return stats, np.concatenate(cov_chunks, axis=1)
     return stats

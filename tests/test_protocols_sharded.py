@@ -237,3 +237,72 @@ def test_sharded_pull_matches_single_device():
                 seed=seed, **kw,
             )
             assert got.equal_counts(want), (shares, nodes, kw.keys())
+
+
+@pytest.mark.parametrize("protocol", ["pushpull", "pull", "pushk"])
+@pytest.mark.parametrize("ring_mode", ["replicated", "sharded"])
+def test_partnered_ring_modes_bitwise_equal(protocol, ring_mode):
+    """Both history-ring layouts give single-device-identical counters for
+    every partnered protocol, under per-edge (lognormal) delays — the
+    sharded layout reads the partner state via per-delay-value slice
+    all_gathers (anti-entropy) or purely locally (fanout push)."""
+    from p2p_gossip_tpu.models.latency import lognormal_delays
+    from p2p_gossip_tpu.models.protocols import run_pushk_sim, run_pushpull_sim
+
+    g = pg.erdos_renyi(64, 0.12, seed=21)
+    d = lognormal_delays(g, mean_ticks=2.0, sigma=0.7, max_ticks=5, seed=21)
+    sched = pg.uniform_renewal_schedule(64, sim_time=3.0, tick_dt=0.01, seed=21)
+    if protocol == "pushk":
+        single, _ = run_pushk_sim(
+            g, sched, 60, fanout=2, ell_delays=d, seed=9
+        )
+        kw = dict(fanout=2)
+    else:
+        single, _ = run_pushpull_sim(
+            g, sched, 60, ell_delays=d, seed=9, mode=protocol
+        )
+        kw = {}
+    mesh = make_mesh(4, 2)
+    sh = run_sharded_partnered_sim(
+        g, sched, 60, mesh, protocol=protocol, ell_delays=d, seed=9,
+        chunk_size=32, ring_mode=ring_mode, **kw,
+    )
+    assert sh.equal_counts(single), f"{protocol}/{ring_mode} diverges"
+    assert sh.extra["ring"]["mode"] == ring_mode
+    if ring_mode == "sharded" and protocol != "pushk":
+        assert sh.extra["ring"]["delay_splits"] > 1
+
+
+def test_partnered_ring_auto_policy():
+    """auto: pushk -> sharded (drops the exchange all_gather); anti with
+    uniform delay -> sharded; anti with small multi-delay ring ->
+    replicated."""
+    from p2p_gossip_tpu.models.latency import lognormal_delays
+    from p2p_gossip_tpu.models.protocols import run_pushk_sim, run_pushpull_sim
+
+    g = pg.erdos_renyi(48, 0.15, seed=5)
+    sched = pg.uniform_renewal_schedule(48, sim_time=2.0, tick_dt=0.01, seed=5)
+    mesh = make_mesh(4, 2)
+
+    single, _ = run_pushk_sim(g, sched, 40, fanout=2, seed=3)
+    sh = run_sharded_partnered_sim(
+        g, sched, 40, mesh, protocol="pushk", fanout=2, seed=3, chunk_size=32
+    )
+    assert sh.equal_counts(single)
+    assert sh.extra["ring"]["mode"] == "sharded"
+
+    single, _ = run_pushpull_sim(g, sched, 40, seed=3)
+    sh = run_sharded_partnered_sim(
+        g, sched, 40, mesh, protocol="pushpull", seed=3, chunk_size=32
+    )
+    assert sh.equal_counts(single)
+    assert sh.extra["ring"]["mode"] == "sharded"  # uniform delay
+
+    d = lognormal_delays(g, mean_ticks=2.0, sigma=0.7, max_ticks=4, seed=5)
+    single, _ = run_pushpull_sim(g, sched, 40, ell_delays=d, seed=3)
+    sh = run_sharded_partnered_sim(
+        g, sched, 40, mesh, protocol="pushpull", ell_delays=d, seed=3,
+        chunk_size=32,
+    )
+    assert sh.equal_counts(single)
+    assert sh.extra["ring"]["mode"] == "replicated"  # small ring
