@@ -62,6 +62,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="Share-axis shards for --backend sharded",
     )
     p.add_argument(
+        "--ringMode", choices=("auto", "replicated", "sharded"),
+        default="auto",
+        help="History-ring layout for --backend sharded: replicated "
+        "(full ring per chip, write-time all_gather) or sharded "
+        "(per-chip rows, read-time slice all_gathers — fits rings the "
+        "replicated layout can't). auto picks by delay model and size.",
+    )
+    p.add_argument(
         "--topology",
         choices=("er", "ba", "ring", "ws", "grid", "torus", "complete"),
         default="er",
@@ -280,7 +288,7 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
                 g, sched, horizon, mesh, protocol=args.protocol,
                 ell_delays=delays, seed=args.seed,
                 chunk_size=args.chunkSize, churn=churn, loss=loss,
-                record_coverage=True, **kw,
+                record_coverage=True, ring_mode=args.ringMode, **kw,
             )
         else:
             from p2p_gossip_tpu.models.protocols import (
@@ -306,7 +314,7 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
         stats, coverage = run_sharded_flood_coverage(
             g, origins, horizon, mesh, ell_delays=delays,
             chunk_size=args.chunkSize, block=args.degreeBlock or None,
-            churn=churn, loss=loss,
+            churn=churn, loss=loss, ring_mode=args.ringMode,
         )
     else:
         stats, coverage = run_flood_coverage(
@@ -703,6 +711,7 @@ def run(argv=None) -> int:
             chunk_size=args.chunkSize, churn=churn, loss=loss,
             checkpoint_path=args.checkpoint or None,
             checkpoint_every=args.checkpointEvery,
+            ring_mode=args.ringMode,
         )
     elif args.protocol in ("pushpull", "pull", "pushk") and args.backend == "native":
         from p2p_gossip_tpu.runtime.native import run_native_partnered_sim
@@ -766,6 +775,7 @@ def run(argv=None) -> int:
             checkpoint_path=args.checkpoint or None,
             checkpoint_every=args.checkpointEvery,
             connect_tick=args.connectAtTick,
+            ring_mode=args.ringMode,
         )
     elif args.backend == "native":
         from p2p_gossip_tpu.runtime.native import run_native_sim

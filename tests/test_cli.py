@@ -389,3 +389,27 @@ def test_connect_at_tick_rejected_on_flood_coverage_and_negative(capsys):
         "--anim", "/tmp/x.xml", "--backend", "event",
     ])
     assert rc == 2
+
+
+def test_ring_mode_cli(capsys):
+    """--ringMode selects the sharded engine's history-ring layout; both
+    layouts match the event backend's totals."""
+    from p2p_gossip_tpu.utils.cli import run
+
+    common = [
+        "--numNodes", "40", "--connectionProb", "0.15", "--simTime", "4",
+        "--Latency", "5", "--seed", "11", "--chunkSize", "32",
+        "--delayModel", "lognormal",
+    ]
+    assert run(common + ["--backend", "event"]) == 0
+    event_out = capsys.readouterr().out
+
+    def totals(s):
+        return [l for l in s.splitlines() if l.startswith("Total ")]
+
+    for mode in ("replicated", "sharded"):
+        rc = run(common + ["--backend", "sharded", "--meshNodes", "4",
+                           "--meshShares", "2", "--ringMode", mode])
+        out = capsys.readouterr().out
+        assert rc == 0, mode
+        assert totals(out) == totals(event_out), mode

@@ -59,7 +59,17 @@ def _random_config(seed: int):
         if rng.random() < 0.5
         else None
     )
-    return g, sched, horizon, delays, churn, loss, snaps
+    connect = (
+        int(rng.integers(1, max(horizon // 2, 2)))
+        if rng.random() < 0.3
+        else 0
+    )
+    mesh_shape = [(8, 1), (4, 2), (2, 4)][int(rng.integers(0, 3))]
+    ring_mode = ["auto", "replicated", "sharded"][int(rng.integers(0, 3))]
+    return (
+        g, sched, horizon, delays, churn, loss, snaps, connect,
+        mesh_shape, ring_mode,
+    )
 
 
 @pytest.mark.parametrize(
@@ -67,14 +77,15 @@ def _random_config(seed: int):
     "seed", range(int(os.environ.get("P2P_FUZZ_SEEDS", "8")))
 )
 def test_three_engine_parity_random_config(seed):
-    g, sched, horizon, delays, churn, loss, snaps = _random_config(seed)
+    (g, sched, horizon, delays, churn, loss, snaps, connect, mesh_shape,
+     ring_mode) = _random_config(seed)
     ev = run_event_sim(
         g, sched, horizon, ell_delays=delays, churn=churn, loss=loss,
-        snapshot_ticks=snaps,
+        snapshot_ticks=snaps, connect_tick=connect,
     )
     sy = run_sync_sim(
         g, sched, horizon, ell_delays=delays, chunk_size=64, churn=churn,
-        loss=loss, snapshot_ticks=snaps,
+        loss=loss, snapshot_ticks=snaps, connect_tick=connect,
     )
     for f in COUNTERS:
         assert np.array_equal(getattr(ev, f), getattr(sy, f)), (seed, f)
@@ -83,7 +94,7 @@ def test_three_engine_parity_random_config(seed):
     if native.available():
         nt = native.run_native_sim(
             g, sched, horizon, ell_delays=delays, churn=churn, loss=loss,
-            snapshot_ticks=snaps,
+            snapshot_ticks=snaps, connect_tick=connect,
         )
         for f in COUNTERS:
             assert np.array_equal(getattr(ev, f), getattr(nt, f)), (seed, f)
@@ -91,7 +102,26 @@ def test_three_engine_parity_random_config(seed):
             assert ev.extra.get("snapshots", []) == nt.extra.get(
                 "snapshots", []
             )
-    ev.check_conservation()
+    # Fourth engine: the mesh, with a drawn shape and ring layout.
+    import jax
+
+    from p2p_gossip_tpu.parallel.engine_sharded import run_sharded_sim
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(*mesh_shape, devices=jax.devices("cpu"))
+    sh = run_sharded_sim(
+        g, sched, horizon, mesh, ell_delays=delays, chunk_size=32,
+        churn=churn, loss=loss, snapshot_ticks=snaps, connect_tick=connect,
+        ring_mode=ring_mode,
+    )
+    for f in COUNTERS:
+        assert np.array_equal(getattr(ev, f), getattr(sh, f)), (
+            seed, f, mesh_shape, ring_mode,
+        )
+    if snaps is not None:
+        assert ev.extra.get("snapshots", []) == sh.extra.get("snapshots", [])
+    if not connect:
+        ev.check_conservation()
 
 
 def test_connect_tick_warmup_parity_all_engines():
