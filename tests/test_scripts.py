@@ -1,0 +1,50 @@
+"""Smoke tests for the measurement scripts — they generate the judge- and
+operator-facing artifacts (kernel A/B tables, protocol comparisons), so
+they must keep producing parseable output even as the library evolves.
+CPU-pinned, tiny shapes; the real numbers come from TPU runs."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_script(script, *args, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", script), *args],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=timeout,
+    )
+
+
+def test_kernel_bench_smoke_emits_parseable_rows():
+    r = _run_script(
+        "kernel_bench.py", "--rows", "1024", "--words", "4", "--iters", "2",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows = [json.loads(line) for line in r.stdout.strip().splitlines()]
+    kernels = {row["kernel"] for row in rows}
+    assert {
+        "coverage_per_slot", "tick_update", "gather_or_xla",
+        "gather_or_pallas_rejection",
+    } <= kernels
+    for row in rows:
+        if "parity" in row:
+            assert row["parity"] == "ok"
+
+
+def test_protocol_compare_smoke_json():
+    r = _run_script(
+        "protocol_compare.py", "--json", "--nodes", "200", "--prob", "0.03",
+        "--shares", "4", "--horizon", "32",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads(r.stdout)
+    protos = {row["protocol"].split("(")[0] for row in payload["results"]}
+    assert {"flood", "pushpull", "pull", "pushk"} <= protos
+    # Strict JSON round-trip (the sends_per_delivery None contract).
+    json.loads(json.dumps(payload))
