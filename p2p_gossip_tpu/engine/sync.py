@@ -267,8 +267,13 @@ def apply_tick_updates(
 def _tick_body(
     dg: DeviceGraph, block: int, state, origins, slots, gen_ticks, churn=None,
     loss=None, use_pallas_tick: bool = False, connect_tick: int = 0,
+    cov_slots: int | None = None,
 ):
     """One synchronous tick. state = (t, seen, hist, received, sent).
+    Returns ``(state', cov_delta)`` — cov_delta is the per-slot coverage
+    gained this tick when the fused coverage kernel ran (``cov_slots``
+    set AND ``use_pallas_tick``), else None and the caller derives it
+    from the hist slot just written.
 
     ``churn`` is an optional ``(down_start, down_end)`` pair of (N, K)
     interval arrays (models/churn.py): a down node's arrivals are lost
@@ -279,6 +284,9 @@ def _tick_body(
     erasure model (models/linkloss.py), applied edge-wise inside the
     gather before the OR-reduce.
     """
+    assert not (connect_tick and cov_slots is not None), (
+        "coverage runs never model the warm-up window"
+    )
     t, seen, hist, received, sent = state
     n, w = seen.shape
     if dg.buckets is not None:
@@ -309,6 +317,7 @@ def _tick_body(
         .at[origins]
         .add(gen_active.astype(jnp.int32))
     )
+    cov_delta = None
     if connect_tick:
         # Socket warm-up window (p2pnetwork.cc:93-96): a whole tick is
         # either pre- or post-connect. Pre-connect generations enter the
@@ -323,13 +332,24 @@ def _tick_body(
             use_pallas=use_pallas_tick,
         )
         seen = seen | jnp.where(pre, gen_bits, jnp.uint32(0))
+    elif cov_slots is not None and use_pallas_tick:
+        # Coverage-recording fast path: the fused kernel emits the tick's
+        # coverage delta from the tile already in VMEM — zero extra HBM
+        # passes for per-tick coverage (the 1M north-star metric).
+        from p2p_gossip_tpu.ops.pallas_kernels import tick_update_cov_pallas
+
+        seen, newly_out, newly_cnt, cov_delta = tick_update_cov_pallas(
+            arrivals, seen, gen_bits, cov_slots
+        )
+        received = received + newly_cnt
+        sent = sent + (newly_cnt + gen_cnt) * dg.degree
     else:
         seen, newly_out, received, sent = apply_tick_updates(
             seen, arrivals, gen_bits, gen_cnt, received, sent, dg.degree,
             use_pallas=use_pallas_tick,
         )
     hist = hist.at[jnp.mod(t, dg.ring_size)].set(newly_out)
-    return (t + 1, seen, hist, received, sent)
+    return (t + 1, seen, hist, received, sent), cov_delta
 
 
 @functools.partial(
@@ -386,7 +406,7 @@ def _run_chunk_while(
             snaps = jnp.where(
                 (snap_ticks == t)[:, None], received[None, :], snaps
             )
-        t, seen, hist, received, sent = _tick_body(
+        (t, seen, hist, received, sent), _ = _tick_body(
             dg, block, (t, seen, hist, received, sent), origins, slots,
             gen_ticks, churn, loss, use_pallas_tick, connect_tick,
         )
@@ -427,23 +447,31 @@ def _run_chunk_coverage(
     metrics. Returns per-tick coverage (horizon, S) but exits the tick loop
     at quiescence (coverage is constant once nothing is in flight; the
     remaining rows are filled with the final value), so a generous horizon
-    costs nothing extra. ``use_pallas`` selects the one-pass coverage
-    kernel (ops/pallas_kernels.py) on TPU. ``coverage_slots`` limits the
-    recorded coverage to the first S slots (the live shares) — the chunk
-    itself may be lane-padded far wider (MIN_CHUNK_SHARES)."""
+    costs nothing extra.
+
+    Coverage is accumulated INCREMENTALLY: each (node, share) bit enters
+    the ``newly_out`` frontier at most once (dedup makes ticks disjoint),
+    so per-tick coverage is a running sum of the frontier's per-slot
+    counts — reading the just-written (N, cov_w) hist slot instead of
+    re-reducing the full seen bitmask, and falling out of the fused tick
+    kernel entirely (zero extra HBM passes) when ``use_pallas_tick``.
+    ``use_pallas`` selects the one-pass coverage kernel for the delta
+    reduction on TPU. ``coverage_slots`` limits the recorded coverage to
+    the first S slots (the live shares) — the chunk itself may be
+    lane-padded far wider (MIN_CHUNK_SHARES)."""
     n, w = dg.n, bitmask.num_words(chunk_size)
     cov_slots = chunk_size if coverage_slots is None else coverage_slots
     cov_w = bitmask.num_words(cov_slots)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
     last_gen = jnp.max(jnp.where(gen_ticks < horizon, gen_ticks, 0))
 
-    def coverage_of(seen):
-        live_seen = seen[:, :cov_w]
+    def cov_delta_of(newly_out):
+        live = newly_out[:, :cov_w]
         if use_pallas:
             from p2p_gossip_tpu.ops.pallas_kernels import coverage_per_slot_pallas
 
-            return coverage_per_slot_pallas(live_seen, cov_slots)
-        return bitmask.coverage_per_slot(live_seen, cov_slots)
+            return coverage_per_slot_pallas(live, cov_slots)
+        return bitmask.coverage_per_slot(live, cov_slots)
 
     state = (
         jnp.zeros((), dtype=jnp.int32),
@@ -451,30 +479,41 @@ def _run_chunk_coverage(
         jnp.zeros((dg.ring_size, n, w), dtype=jnp.uint32),
         jnp.zeros((n,), dtype=jnp.int32),
         jnp.zeros((n,), dtype=jnp.int32),
+        jnp.zeros((cov_slots,), dtype=jnp.int32),   # running coverage
         jnp.zeros((horizon, cov_slots), dtype=jnp.int32),
     )
 
     def cond(full_state):
-        t, _, hist, _, _, _ = full_state
+        t, _, hist, _, _, _, _ = full_state
         return (t < horizon) & (jnp.any(hist != 0) | (t <= last_gen))
 
     def step(full_state):
-        t, seen, hist, received, sent, cov_hist = full_state
-        state = _tick_body(
+        t, seen, hist, received, sent, cov_run, cov_hist = full_state
+        # The fused tick+coverage kernel embeds the same revisited
+        # coverage accumulator the coverage-kernel row bound quarantines
+        # (the unresolved-1M-crash suspect) — require BOTH gates.
+        fused_cov = use_pallas_tick and use_pallas
+        new_state, cov_delta = _tick_body(
             dg, block, (t, seen, hist, received, sent), origins, slots,
             gen_ticks, churn, loss, use_pallas_tick,
+            cov_slots=cov_slots if fused_cov else None,
         )
+        if cov_delta is None:
+            # hist slot (t mod D) was written by this tick: it IS the
+            # newly_out frontier.
+            cov_delta = cov_delta_of(new_state[2][jnp.mod(t, dg.ring_size)])
+        cov_run = cov_run + cov_delta
         cov_hist = jax.lax.dynamic_update_slice(
-            cov_hist, coverage_of(state[1])[None], (t, 0)
+            cov_hist, cov_run[None], (t, 0)
         )
-        return (*state, cov_hist)
+        return (*new_state, cov_run, cov_hist)
 
-    t, seen, _, received, sent, cov_hist = jax.lax.while_loop(
+    t, seen, _, received, sent, cov_run, cov_hist = jax.lax.while_loop(
         cond, step, state
     )
     # Rows past quiescence hold the (monotone, now constant) final coverage.
     ticks = jnp.arange(horizon, dtype=jnp.int32)[:, None]
-    coverage = jnp.where(ticks >= t, coverage_of(seen)[None, :], cov_hist)
+    coverage = jnp.where(ticks >= t, cov_run[None, :], cov_hist)
     return seen, received, sent, coverage
 
 

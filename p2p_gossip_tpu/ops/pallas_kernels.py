@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from p2p_gossip_tpu.ops.bitmask import WORD_BITS
+from p2p_gossip_tpu.ops.bitmask import WORD_BITS, num_words
 
 DEFAULT_ROW_TILE = 256
 
@@ -84,27 +84,42 @@ def tick_rows_ok(n_rows: int) -> bool:
     return _rows_ok(n_rows, "P2P_PALLAS_TICK_MAX_ROWS", PALLAS_TICK_MAX_ROWS)
 
 
+def _bit_column_counts(tile: jnp.ndarray) -> jnp.ndarray:
+    """(TILE_N, W) uint32 -> (32, W) int32 per-bit column counts. The bit
+    expansion is one broadcast shift over the VMEM-resident tile (measured
+    faster than 32 per-bit strided accumulator updates, which are
+    sublane-hostile); the (TILE_N, 32, W) transient lives on-chip only.
+    Shared by every kernel that accumulates per-slot coverage."""
+    shifts = jax.lax.broadcasted_iota(jnp.uint32, (WORD_BITS, 1), 0)
+    bits = (
+        (tile[:, None, :] >> shifts[None, :, :]) & jnp.uint32(1)
+    ).astype(jnp.int32)
+    return jnp.sum(bits, axis=0)
+
+
+def _tick_update_compute(arr, sn, gb):
+    """The fused tick update on one VMEM tile: returns
+    (seen', newly_out, newly_cnt). Shared by the tick kernels so the
+    semantics can't diverge between the plain and +coverage variants."""
+    newly = arr & ~sn
+    cnt = jnp.sum(
+        jax.lax.population_count(newly).astype(jnp.int32),
+        axis=1, keepdims=True,
+    )
+    return sn | arr | gb, newly | gb, cnt
+
+
 def _coverage_kernel(seen_ref, acc_ref):
     """Grid: row tiles. seen_ref: (TILE_N, W) uint32 in VMEM. acc_ref:
     (32, W) int32 — the same output block revisited by every grid step,
-    accumulated in place (classic TPU revisited-output pattern).
-
-    The bit expansion is one broadcast shift over the VMEM-resident tile
-    (measured faster than 32 per-bit strided accumulator updates, which are
-    sublane-hostile); the (TILE_N, 32, W) transient lives on-chip only.
-    """
+    accumulated in place (classic TPU revisited-output pattern)."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    tile = seen_ref[:]
-    shifts = jax.lax.broadcasted_iota(jnp.uint32, (WORD_BITS, 1), 0)
-    bits = (
-        (tile[:, None, :] >> shifts[None, :, :]) & jnp.uint32(1)
-    ).astype(jnp.int32)
-    acc_ref[:] += jnp.sum(bits, axis=0)
+    acc_ref[:] += _bit_column_counts(seen_ref[:])
 
 
 @functools.partial(jax.jit, static_argnames=("n_slots", "row_tile", "interpret"))
@@ -152,15 +167,8 @@ def _tick_update_kernel(
     the unfused XLA graph materializes `newly`, `seen'`, and `newly_out`
     as separate kernels re-reading their inputs (~8 reads / 3 writes).
     """
-    arr = arrivals_ref[:]
-    sn = seen_ref[:]
-    gb = gen_ref[:]
-    newly = arr & ~sn
-    seen_out_ref[:] = sn | arr | gb
-    newly_out_ref[:] = newly | gb
-    cnt_ref[:] = jnp.sum(
-        jax.lax.population_count(newly).astype(jnp.int32),
-        axis=1, keepdims=True,
+    seen_out_ref[:], newly_out_ref[:], cnt_ref[:] = _tick_update_compute(
+        arrivals_ref[:], seen_ref[:], gen_ref[:]
     )
 
 
@@ -206,6 +214,90 @@ def tick_update_pallas(
         interpret=interpret,
     )(arrivals, seen, gen_bits)
     return seen_out[:n], newly_out[:n], cnt[:n, 0]
+
+
+def _make_tick_update_cov_kernel(cov_w: int):
+    """Tick update fused with the per-slot coverage DELTA of the tick.
+
+    Coverage is a cumulative sum over ticks of the newly-acquired
+    frontier's per-slot bit-column counts (each (node, share) bit enters
+    ``newly_out`` at most once — dedup guarantees disjointness across
+    ticks), so the delta falls out of the tile already in VMEM: the
+    coverage-recording tick costs ZERO extra HBM passes over the
+    separate-coverage formulation's full (N, W) re-read per tick. The
+    (32, cov_w) accumulator is a revisited output across the row grid,
+    like `_coverage_kernel`."""
+
+    def kernel(arr_ref, seen_ref, gen_ref,
+               seen_out_ref, newly_out_ref, cnt_ref, cov_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            cov_ref[:] = jnp.zeros_like(cov_ref)
+
+        seen_out, nout, cnt = _tick_update_compute(
+            arr_ref[:], seen_ref[:], gen_ref[:]
+        )
+        seen_out_ref[:] = seen_out
+        newly_out_ref[:] = nout
+        cnt_ref[:] = cnt
+        cov_ref[:] += _bit_column_counts(nout[:, :cov_w])
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cov_slots", "row_tile", "interpret")
+)
+def tick_update_cov_pallas(
+    arrivals: jnp.ndarray,  # (N, W) uint32
+    seen: jnp.ndarray,      # (N, W) uint32
+    gen_bits: jnp.ndarray,  # (N, W) uint32
+    cov_slots: int,
+    row_tile: int = DEFAULT_ROW_TILE,
+    interpret: bool = False,
+):
+    """Fused tick update + coverage delta: returns
+    (seen', newly_out, newly_cnt, cov_delta) with cov_delta (cov_slots,)
+    int32 — the number of nodes acquiring each of the first ``cov_slots``
+    shares THIS tick. Bitwise-identical to `tick_update_pallas` plus
+    `bitmask.coverage_per_slot(newly_out[:, :cov_w], cov_slots)`."""
+    n, w = seen.shape
+    cov_w = num_words(cov_slots)
+    assert cov_w <= w
+    pad = (-n) % row_tile
+    if pad:
+        arrivals = jnp.pad(arrivals, ((0, pad), (0, 0)))
+        seen = jnp.pad(seen, ((0, pad), (0, 0)))
+        gen_bits = jnp.pad(gen_bits, ((0, pad), (0, 0)))
+    n_padded = seen.shape[0]
+    grid = (n_padded // row_tile,)
+    tile = lambda: pl.BlockSpec(  # noqa: E731
+        (row_tile, w), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    seen_out, newly_out, cnt, acc = pl.pallas_call(
+        _make_tick_update_cov_kernel(cov_w),
+        grid=grid,
+        in_specs=[tile(), tile(), tile()],
+        out_specs=(
+            tile(),
+            tile(),
+            pl.BlockSpec((row_tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (WORD_BITS, cov_w), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((n_padded, w), jnp.uint32),
+            jax.ShapeDtypeStruct((n_padded, w), jnp.uint32),
+            jax.ShapeDtypeStruct((n_padded, 1), jnp.int32),
+            jax.ShapeDtypeStruct((WORD_BITS, cov_w), jnp.int32),
+        ),
+        interpret=interpret,
+    )(arrivals, seen, gen_bits)
+    cov_delta = acc.T.reshape(cov_w * WORD_BITS)[:cov_slots]
+    return seen_out[:n], newly_out[:n], cnt[:n, 0], cov_delta
 
 
 def _popcount_rows_kernel(words_ref, out_ref):

@@ -92,6 +92,7 @@ def main():
     from p2p_gossip_tpu.ops import bitmask
     from p2p_gossip_tpu.ops.pallas_kernels import (
         coverage_per_slot_pallas,
+        tick_update_cov_pallas,
         tick_update_pallas,
     )
 
@@ -159,6 +160,42 @@ def main():
         xla_ms=round(t_xla * 1e3, 3), pallas_ms=round(t_pal * 1e3, 3),
         speedup=round(t_xla / t_pal, 3), parity="ok",
         pallas_gbps=round(bytes_min / t_pal / 1e9, 1),
+    )
+
+    # --- 2b. fused tick update + coverage delta ------------------------
+    # The kernel _run_chunk_coverage actually executes at scale — its
+    # hardware validation is what PALLAS_TICK_MAX_ROWS records, so it
+    # must be exercised here, not inferred from the plain tick kernel.
+    slots_cov = args.words * 32
+    want_cov = np.asarray(bitmask.coverage_per_slot(np.asarray(want[1]), slots_cov))
+    got_cov = tick_update_cov_pallas(
+        arrivals, seen0, gen_bits, slots_cov, interpret=interpret
+    )
+    assert np.array_equal(np.asarray(want[0]), np.asarray(got_cov[0]))
+    assert np.array_equal(np.asarray(want[1]), np.asarray(got_cov[1]))
+    assert np.array_equal(want_cov, np.asarray(got_cov[3]))
+
+    def xla_tick_cov(s):
+        out = apply_tick_updates(s, arrivals, gen_bits, z, z, z, deg)
+        cov = bitmask.coverage_per_slot(out[1], slots_cov)
+        return out[0] ^ out[1] ^ cov[0].astype(jnp.uint32)
+
+    def pallas_tick_cov(s):
+        sk, nk, _, cov = tick_update_cov_pallas(
+            arrivals, s, gen_bits, slots_cov, interpret=interpret
+        )
+        return sk ^ nk ^ cov[0].astype(jnp.uint32)
+
+    t_xla = chain_time(xla_tick_cov, seen0, args.iters)
+    t_pal = chain_time(pallas_tick_cov, seen0, args.iters)
+    log(
+        f"tick-update+coverage N={n} W={w}: xla {t_xla*1e3:.2f} ms  "
+        f"pallas {t_pal*1e3:.2f} ms"
+    )
+    emit(
+        kernel="tick_update_cov", rows=n, words=w,
+        xla_ms=round(t_xla * 1e3, 3), pallas_ms=round(t_pal * 1e3, 3),
+        speedup=round(t_xla / t_pal, 3), parity="ok",
     )
 
     # --- 3. gather-OR (XLA path + the Pallas rejection arithmetic) -----

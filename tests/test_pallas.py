@@ -141,3 +141,30 @@ def test_tick_rows_gate(monkeypatch):
     assert not tick_rows_ok(100)
     monkeypatch.setenv("P2P_PALLAS_TICK_MAX_ROWS", "1000")
     assert tick_rows_ok(1000) and not tick_rows_ok(1001)
+
+
+def test_tick_update_cov_kernel_matches_unfused():
+    """Fused tick+coverage kernel == tick_update_pallas + the per-slot
+    coverage of newly_out's first cov_w words."""
+    from p2p_gossip_tpu.ops.pallas_kernels import (
+        tick_update_cov_pallas,
+        tick_update_pallas,
+    )
+
+    rng = np.random.default_rng(11)
+    n, w, cov_slots = 700, 4, 96  # cov_w=3 < w
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.integers(0, 2**32, size=(n, w), dtype=np.uint64).astype(np.uint32)
+    )
+    arrivals, seen, gen_bits = mk(), mk(), mk()
+    s1, n1, c1 = tick_update_pallas(
+        arrivals, seen, gen_bits, row_tile=128, interpret=True
+    )
+    s2, n2, c2, cov = tick_update_cov_pallas(
+        arrivals, seen, gen_bits, cov_slots, row_tile=128, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    np.testing.assert_array_equal(np.asarray(n1), np.asarray(n2))
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+    want = bitmask.coverage_per_slot(jnp.asarray(n1)[:, :3], cov_slots)
+    np.testing.assert_array_equal(np.asarray(cov), np.asarray(want))

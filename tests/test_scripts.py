@@ -29,8 +29,8 @@ def test_kernel_bench_smoke_emits_parseable_rows():
     rows = [json.loads(line) for line in r.stdout.strip().splitlines()]
     kernels = {row["kernel"] for row in rows}
     assert {
-        "coverage_per_slot", "tick_update", "gather_or_xla",
-        "gather_or_pallas_rejection",
+        "coverage_per_slot", "tick_update", "tick_update_cov",
+        "gather_or_xla", "gather_or_pallas_rejection",
     } <= kernels
     for row in rows:
         if "parity" in row:
