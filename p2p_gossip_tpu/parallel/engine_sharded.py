@@ -316,6 +316,10 @@ def build_sharded_runner(
             jnp.zeros((n_loc,), dtype=jnp.int32),                 # sent
             jnp.zeros((num_snaps, n_loc), dtype=jnp.int32),       # snapshots
             jnp.zeros(
+                (cov_slots if record_coverage else 0,),
+                dtype=jnp.int32,
+            ),                                                    # running cov
+            jnp.zeros(
                 (horizon if record_coverage else 0,
                  cov_slots if record_coverage else 0),
                 dtype=jnp.int32,
@@ -323,7 +327,7 @@ def build_sharded_runner(
         )
 
         def cond(state):
-            t, _, hist, _, _, _, _ = state
+            t, _, hist = state[0], state[1], state[2]
             # Local ring rows are a subset (sharded) or a replica
             # (replicated) of the global frontier state; the mesh-wide
             # OR-reduce makes the predicate uniform either way.
@@ -371,7 +375,7 @@ def build_sharded_runner(
             return acc
 
         def body(state):
-            t, seen, hist, received, sent, snaps, cov_hist = state
+            t, seen, hist, received, sent, snaps, cov_run, cov_hist = state
             if num_snaps:
                 snaps = jnp.where(
                     (snap_ticks == t)[:, None], received[None, :], snaps
@@ -420,19 +424,25 @@ def build_sharded_runner(
                 )
                 hist = hist.at[jnp.mod(t, ring_size)].set(newly_full)
             if record_coverage:
-                cov = lax.psum(local_coverage(seen), NODES_AXIS)
-                cov_hist = lax.dynamic_update_slice(cov_hist, cov[None], (t, 0))
-            return (t + 1, seen, hist, received, sent, snaps, cov_hist)
+                # Incremental, like engine.sync: newly_out bits are
+                # disjoint across ticks, so the mesh-wide coverage is a
+                # running sum of the local frontier's per-slot counts.
+                cov_run = cov_run + lax.psum(
+                    local_coverage(newly_out), NODES_AXIS
+                )
+                cov_hist = lax.dynamic_update_slice(
+                    cov_hist, cov_run[None], (t, 0)
+                )
+            return (t + 1, seen, hist, received, sent, snaps, cov_run, cov_hist)
 
-        t, seen, _, received, sent, snaps, cov_hist = lax.while_loop(
+        t, seen, _, received, sent, snaps, cov_run, cov_hist = lax.while_loop(
             cond, body, state
         )
         if record_coverage:
             # Rows past quiescence hold the (monotone, now constant) final
             # coverage — same convention as the sync engine.
-            final = lax.psum(local_coverage(seen), NODES_AXIS)
             ticks = jnp.arange(horizon, dtype=jnp.int32)[:, None]
-            cov_hist = jnp.where(ticks >= t, final[None, :], cov_hist)
+            cov_hist = jnp.where(ticks >= t, cov_run[None, :], cov_hist)
         if num_snaps:
             # Boundaries at/after quiescence see the (unchanging) final
             # counts — same convention as the sync engine.
