@@ -78,8 +78,20 @@ def coverage_per_slot(seen: jnp.ndarray, n_slots: int) -> jnp.ndarray:
     """Per-share coverage: (N, W) seen-bitmask -> (S,) int32 node counts.
 
     Drives the time-to-99%-coverage metric from BASELINE.json.
+
+    Formulated as 32 per-bit reductions (one (N, W) read each, fusable
+    by XLA into few passes) rather than a broadcast bit expansion: the
+    expansion's (N, W, 32) int32 intermediate is ~16 GB at the 1M-node
+    benchmark shape if XLA materializes it — larger than a v5e's HBM.
+    The Pallas kernel (`ops.pallas_kernels.coverage_per_slot_pallas`)
+    remains the on-chip fast path; this is the oracle and the fallback.
     """
     n_words = seen.shape[-1]
-    bits = (seen[..., None] >> jnp.arange(WORD_BITS, dtype=jnp.uint32)) & jnp.uint32(1)
-    counts = jnp.sum(bits.astype(jnp.int32), axis=0).reshape(n_words * WORD_BITS)
-    return counts[:n_slots]
+    counts = jnp.stack(
+        [
+            jnp.sum(((seen >> jnp.uint32(b)) & jnp.uint32(1)).astype(jnp.int32), axis=0)
+            for b in range(WORD_BITS)
+        ],
+        axis=1,
+    )  # (W, 32): slot s = word s//32, bit s%32
+    return counts.reshape(n_words * WORD_BITS)[:n_slots]
