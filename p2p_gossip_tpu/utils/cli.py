@@ -363,6 +363,42 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
         f"Simulated {horizon} ticks in {wall:.3f}s wall "
         f"({stats.totals()['processed'] / max(wall, 1e-9):.3g} node-updates/s)"
     )
+    if args.json:
+        import json
+
+        frac = args.coverageFraction
+        print(
+            json.dumps(
+                {
+                    "config": {
+                        "numNodes": g.n,
+                        "edges": int(g.num_edges),
+                        "protocol": args.protocol,
+                        "backend": args.backend,
+                        "shares": int(args.floodCoverage),
+                        "coverageFraction": frac,
+                        "Latency": args.Latency,
+                        "seed": args.seed,
+                    },
+                    "reached": int(reached.sum()),
+                    "ttc_ticks": {
+                        "min": int(ttc[reached].min()),
+                        "median": float(np.median(ttc[reached])),
+                        "max": int(ttc[reached].max()),
+                    }
+                    if reached.any()
+                    else None,
+                    "final_coverage": {
+                        "min": int(coverage[-1].min()),
+                        "mean": float(coverage[-1].mean()),
+                        "max": int(coverage[-1].max()),
+                    },
+                    "sends_per_delivery": spd,
+                    "wasted_fraction": red["wasted_fraction"],
+                    "wall_s": round(wall, 4),
+                }
+            )
+        )
     return 0
 
 
@@ -635,13 +671,6 @@ def run(argv=None) -> int:
         return 2
 
     if args.floodCoverage:
-        if args.json:
-            print(
-                "error: --json is not supported with --floodCoverage (its "
-                "report has its own format)",
-                file=sys.stderr,
-            )
-            return 2
         if args.floodCoverage < 0:
             print(
                 f"error: --floodCoverage must be positive, got "

@@ -261,11 +261,14 @@ def test_graph_file_rejects_mismatched_parameters(tmp_path, capsys):
     assert "not a readable graph cache" in capsys.readouterr().err
 
 
-def test_json_rejected_with_flood_coverage(capsys):
+def test_json_supported_with_flood_coverage(capsys):
+    # --json with --floodCoverage emits the coverage-run JSON summary
+    # (see test_flood_coverage_json for the payload contract).
     from p2p_gossip_tpu.utils.cli import run
 
     rc = run(["--numNodes", "20", "--floodCoverage", "4", "--json"])
-    assert rc == 2
+    capsys.readouterr()
+    assert rc == 0
 
 
 def test_pull_credit_bound_is_a_clean_cli_error(capsys):
@@ -413,3 +416,23 @@ def test_ring_mode_cli(capsys):
         out = capsys.readouterr().out
         assert rc == 0, mode
         assert totals(out) == totals(event_out), mode
+
+
+def test_flood_coverage_json(capsys):
+    """--floodCoverage --json emits one strict-JSON summary line after the
+    text report."""
+    import json as _json
+
+    from p2p_gossip_tpu.utils.cli import run
+
+    rc = run([
+        "--numNodes", "60", "--connectionProb", "0.1", "--simTime", "0.2",
+        "--Latency", "5", "--floodCoverage", "8", "--seed", "2", "--json",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    payload = _json.loads(out.strip().splitlines()[-1])
+    assert payload["reached"] == 8
+    assert payload["ttc_ticks"]["min"] >= 1
+    assert payload["final_coverage"]["max"] == 60
+    assert payload["sends_per_delivery"] > 1
