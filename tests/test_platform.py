@@ -126,3 +126,26 @@ def test_bench_fallback_fires_inside_budget(tmp_path):
     # The fallback must actually have fired and be honestly labeled.
     assert "falling back" in proc.stderr
     assert "CPU" in parsed["metric"] and "SMOKE" in parsed["metric"]
+
+
+def test_entry_compile_check_falls_back_to_cpu(tmp_path):
+    """With the tunnel dead, the driver's entry() compile-check must land
+    on host CPU instead of raising — same contract as bench.py."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["P2P_DEVICE_WAIT_S"] = "0.001"
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import __graft_entry__ as ge\n"
+        "fn, args = ge.entry()\n"
+        "import jax\n"
+        "out = jax.jit(fn)(*args)\n"
+        "print('OK', len(out))\n"
+    ) % os.path.join(os.path.dirname(__file__), "..")
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK" in proc.stdout
+    assert "compile-checking on host CPU" in proc.stderr
