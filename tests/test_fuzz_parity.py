@@ -36,11 +36,19 @@ def _random_config(seed: int):
     sched = pg.uniform_renewal_schedule(
         n, sim_time=horizon / 100.0, tick_dt=0.01, seed=seed
     )
-    delays = (
-        lognormal_delays(g, 2.0, 0.5, int(rng.integers(4, 8)), seed=seed)
-        if rng.random() < 0.5
-        else None
-    )
+    delay_kind = rng.random()
+    if delay_kind < 0.4:
+        delays = lognormal_delays(
+            g, 2.0, 0.5, int(rng.integers(4, 8)), seed=seed
+        )
+    elif delay_kind < 0.6:
+        # Uniform delay > 1 (e.g. the serialization model's output):
+        # exercises the single-slice ring read at depth, ring_size = d+1.
+        from p2p_gossip_tpu.models.latency import constant_delays
+
+        delays = constant_delays(g, int(rng.integers(2, 6)))
+    else:
+        delays = None
     churn = (
         random_churn(
             n, horizon, outage_prob=0.3, mean_down_ticks=30.0,
