@@ -143,13 +143,18 @@ def run_event_sim(
         for k, e in enumerate(range(lo, hi)):
             t_arr = now + int(csr_delays[e])
             dst = int(indices[e])
-            if t_arr >= horizon_ticks:
-                if record_messages:
-                    messages.append([node, dst, share, now, t_arr, "horizon"])
-                continue
+            # Outcome precedence: "lost" before "horizon" — the loss coin
+            # fires at send time, so a message that is both dropped and
+            # past-horizon was lost first. Counters are unaffected either
+            # way (both outcomes skip the heap push); this only fixes the
+            # anim/packet-trace attribution.
             if loss is not None and dropped[k]:
                 if record_messages:
                     messages.append([node, dst, share, now, t_arr, "lost"])
+                continue
+            if t_arr >= horizon_ticks:
+                if record_messages:
+                    messages.append([node, dst, share, now, t_arr, "horizon"])
                 continue
             if record_messages:
                 msg_by_seq[seq] = len(messages)

@@ -71,14 +71,16 @@ def serialization_delays(
 
     The reference's links are 5 Mbps point-to-point (`ConnectNodes`,
     p2pnetwork.cc:113): a message of S bytes occupies the link for
-    S*8/bandwidth seconds on top of the propagation latency. For the
-    reference's ~30-byte share messages at 5 Mbps that is 48 us — far
-    below the 5 ms default latency, which is why the base engines model
-    latency only — but larger payloads or slower links push it into whole
-    ticks; this model quantizes the serialization time up to ticks
-    (anything > 0 costs at least one full tick, the pessimistic rounding)
-    and adds it to every edge. Uniform across edges (the reference gives
-    every link one DataRate), so the uniform-delay fast path applies.
+    S*8/bandwidth seconds on top of the propagation latency. The COMBINED
+    per-hop time (latency + serialization) is rounded to the nearest
+    whole tick, floored at 1 — so the reference's ~30-byte shares at
+    5 Mbps (48 us on top of the 5 ms latency, 5.048 ms total) stay at
+    1 tick/hop, matching the reference's effective behavior, while
+    larger payloads or slower links add whole ticks proportionally.
+    (Rounding the serialization time up on its own would silently double
+    the default per-hop delay.) Uniform across edges (the reference
+    gives every link one DataRate), so the uniform-delay fast path
+    applies.
     """
     if latency_ticks < 1:
         raise ValueError("latency_ticks must be >= 1")
@@ -87,7 +89,9 @@ def serialization_delays(
     if bandwidth_mbps <= 0 or tick_dt <= 0:
         raise ValueError("bandwidth_mbps and tick_dt must be > 0")
     ser_s = message_bytes * 8 / (bandwidth_mbps * 1e6)
-    ticks = latency_ticks + int(np.ceil(ser_s / tick_dt))
+    total_s = latency_ticks * tick_dt + ser_s
+    # floor(x + 0.5): half-up, immune to float banker's rounding.
+    ticks = max(1, int(np.floor(total_s / tick_dt + 0.5)))
     return np.full((graph.n, graph.ell_width), ticks, dtype=np.int32)
 
 

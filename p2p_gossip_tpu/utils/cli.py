@@ -562,6 +562,14 @@ def run(argv=None) -> int:
             g, message_bytes=args.shareBytes,
             bandwidth_mbps=args.bandwidthMbps, tick_dt=tick_dt,
         )
+        # Surface the quantization: users picking this model should see
+        # what the latency+serialization time rounded to in whole ticks.
+        print(
+            f"serialization delay model: {args.shareBytes} B at "
+            f"{args.bandwidthMbps:g} Mbps on {args.Latency:g} ms latency "
+            f"-> {int(delays.max())} tick(s)/hop",
+            file=sys.stderr,
+        )
 
     if args.degreeBlock < 0:
         print("error: --degreeBlock must be >= 0", file=sys.stderr)

@@ -48,20 +48,20 @@ DEFAULT_DEVICE_WAIT_S = 480.0
 
 #: Long-wait default for TPU-or-nothing scripts with no CPU fallback
 #: (scale_1m.py, protocol_compare.py): ride out the observed ~1h tunnel
-#: wedge after a worker crash. P2P_DEVICE_WAIT_S still outranks it.
+#: wedge after a worker crash. Override with P2P_LONG_DEVICE_WAIT_S
+#: (its own knob, so an operator bounding bench.py via P2P_DEVICE_WAIT_S
+#: does not silently truncate these deliberately long waits).
 LONG_DEVICE_WAIT_S = 4500.0
 
 
-def device_wait_budget_s() -> float | None:
-    """The operator's device-wait budget (env P2P_DEVICE_WAIT_S), or None
-    when unset or invalid. Invalid values (unparsable, NaN/inf, negative)
-    warn to stderr and are ignored rather than silently clobbering a
-    caller's explicit budget — and NaN in particular would otherwise
-    defeat every deadline comparison and make the wait unbounded again."""
+def _parse_wait_env(var_name: str) -> float | None:
+    """Parse a seconds env var; invalid values (unparsable, NaN/inf,
+    negative) warn to stderr and return None — NaN in particular would
+    defeat every deadline comparison and make a wait unbounded."""
     import math
     import sys
 
-    raw = os.environ.get("P2P_DEVICE_WAIT_S")
+    raw = os.environ.get(var_name)
     if raw is None:
         return None
     try:
@@ -71,11 +71,27 @@ def device_wait_budget_s() -> float | None:
         return val
     except ValueError:
         print(
-            f"ignoring invalid P2P_DEVICE_WAIT_S={raw!r} "
+            f"ignoring invalid {var_name}={raw!r} "
             "(want a finite non-negative number of seconds)",
             file=sys.stderr, flush=True,
         )
         return None
+
+
+def long_device_wait_s() -> float:
+    """Budget for the TPU-or-nothing scripts: P2P_LONG_DEVICE_WAIT_S when
+    set and valid (finite, >= 0), else LONG_DEVICE_WAIT_S."""
+    val = _parse_wait_env("P2P_LONG_DEVICE_WAIT_S")
+    return LONG_DEVICE_WAIT_S if val is None else val
+
+
+def device_wait_budget_s() -> float | None:
+    """The operator's device-wait budget (env P2P_DEVICE_WAIT_S), or None
+    when unset or invalid. Invalid values (unparsable, NaN/inf, negative)
+    warn to stderr and are ignored rather than silently clobbering a
+    caller's explicit budget — and NaN in particular would otherwise
+    defeat every deadline comparison and make the wait unbounded again."""
+    return _parse_wait_env("P2P_DEVICE_WAIT_S")
 
 
 def wait_for_device(
@@ -92,13 +108,16 @@ def wait_for_device(
 
     The wait is governed by ONE bound: a total wall-clock budget
     (``max_wait_s``, defaulting to the P2P_DEVICE_WAIT_S env var or
-    ~8 min), exhausted → TimeoutError. P2P_DEVICE_WAIT_S, when set,
-    outranks a caller-supplied ``max_wait_s`` — it is the operator's
-    per-run escape hatch (e.g. a harness driving a long-default script
-    under a short clock). ``attempts``, if given, additionally caps the
-    probe count (re-raising the last probe error). Callers with their
-    own fallback (bench.py's CPU path) rely on this returning control
-    inside THEIR caller's clock.
+    ~8 min), exhausted → TimeoutError. Against an EXPLICIT caller
+    budget, P2P_DEVICE_WAIT_S only ever RAISES it (max of the two):
+    callers that pass one (the long-wait scripts, via
+    ``long_device_wait_s``) chose it deliberately, and an operator who
+    exported a short budget to bound bench.py must not silently
+    truncate those — the long waits have their own knob,
+    P2P_LONG_DEVICE_WAIT_S. ``attempts``, if given, additionally caps
+    the probe count (re-raising the last probe error). Callers with
+    their own fallback (bench.py's CPU path) rely on this returning
+    control inside THEIR caller's clock.
 
     Used by the benchmark/experiment scripts before their first device
     query; diagnostics go to stderr.
@@ -111,10 +130,24 @@ def wait_for_device(
         force_cpu_backend_if_requested()
         return
     env_budget = device_wait_budget_s()
-    if env_budget is not None:
-        max_wait_s = env_budget
-    elif max_wait_s is None:
-        max_wait_s = DEFAULT_DEVICE_WAIT_S
+    if max_wait_s is None:
+        max_wait_s = (
+            env_budget if env_budget is not None else DEFAULT_DEVICE_WAIT_S
+        )
+    elif env_budget is not None:
+        if env_budget < max_wait_s:
+            # Make the semantics change visible where it bites: before
+            # round 3 the env var truncated explicit budgets, so an
+            # operator may still expect P2P_DEVICE_WAIT_S to bound this
+            # wait. Point at the knob that does.
+            print(
+                f"note: P2P_DEVICE_WAIT_S={env_budget:.0f}s is shorter than "
+                f"this script's explicit {max_wait_s:.0f}s budget and no "
+                "longer truncates it; bound the long-wait scripts with "
+                "P2P_LONG_DEVICE_WAIT_S instead",
+                file=sys.stderr, flush=True,
+            )
+        max_wait_s = max(max_wait_s, env_budget)
     deadline = time.monotonic() + max_wait_s
 
     def budget_exhausted(n_probes: int) -> TimeoutError:

@@ -41,13 +41,13 @@ def main() -> None:
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
-    from p2p_gossip_tpu.utils.platform import LONG_DEVICE_WAIT_S, wait_for_device
+    from p2p_gossip_tpu.utils.platform import long_device_wait_s, wait_for_device
 
     # CPU: deregisters the tunnel plugin. TPU: waits out a wedged tunnel
     # with killable probes instead of hanging on first device query. No
-    # CPU fallback here, so use the long-wait budget; P2P_DEVICE_WAIT_S
-    # still outranks it for harness-driven runs.
-    wait_for_device(max_wait_s=LONG_DEVICE_WAIT_S)
+    # CPU fallback here, so use the long-wait budget (bound per-run with
+    # P2P_LONG_DEVICE_WAIT_S; P2P_DEVICE_WAIT_S can only raise it).
+    wait_for_device(max_wait_s=long_device_wait_s())
 
     import p2p_gossip_tpu as pg
     from p2p_gossip_tpu.engine.sync import run_flood_coverage

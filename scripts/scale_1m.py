@@ -85,11 +85,11 @@ def main() -> None:
     # A wedged TPU tunnel hangs in-process backend init; wait it out with
     # killable subprocess probes (shared with bench.py). Unlike bench.py
     # this script has no CPU fallback — a 1M-node run is TPU-or-nothing —
-    # so use the long-wait budget (P2P_DEVICE_WAIT_S still outranks it
-    # for harness-driven runs).
-    from p2p_gossip_tpu.utils.platform import LONG_DEVICE_WAIT_S, wait_for_device
+    # so use the long-wait budget (bound it per-run with
+    # P2P_LONG_DEVICE_WAIT_S; P2P_DEVICE_WAIT_S can only raise it).
+    from p2p_gossip_tpu.utils.platform import long_device_wait_s, wait_for_device
 
-    wait_for_device(max_wait_s=LONG_DEVICE_WAIT_S)
+    wait_for_device(max_wait_s=long_device_wait_s())
 
     # Initialize the TPU backend BEFORE the multi-GB graph load: the axon
     # tunnel plugin fails to register under the memory pressure / delay of

@@ -74,6 +74,36 @@ def test_bad_env_var_is_ignored_with_warning(tpu_env, capsys):
     assert plat.device_wait_budget_s() is None
 
 
+def test_env_var_only_raises_explicit_budget(tpu_env):
+    # An operator bounding bench.py with a short P2P_DEVICE_WAIT_S must
+    # NOT truncate a deliberately long explicit budget (the TPU-or-nothing
+    # scripts): env vs explicit resolves to the max of the two.
+    tpu_env.setenv("P2P_DEVICE_WAIT_S", "0.01")
+    calls = []
+    _hang_probe(tpu_env, calls)
+    t0 = time.monotonic()
+    with pytest.raises((TimeoutError, subprocess.TimeoutExpired)):
+        plat.wait_for_device(attempts=2, probe_timeout=1, max_wait_s=2.0)
+    # Budget was 2.0s (not 0.01s): the probe ran with its full ~1s clamp
+    # (a 0.01s budget would have clamped the probe timeout to 0.01s).
+    assert calls and any(t > 0.5 for t in calls)
+    # ...and the env raises a SHORTER explicit budget.
+    tpu_env.setenv("P2P_DEVICE_WAIT_S", "1.5")
+    calls.clear()
+    with pytest.raises((TimeoutError, subprocess.TimeoutExpired)):
+        plat.wait_for_device(attempts=2, probe_timeout=1, max_wait_s=0.001)
+    assert any(t > 0.5 for t in calls)
+
+
+def test_long_wait_env_override(tpu_env, capsys):
+    assert plat.long_device_wait_s() == plat.LONG_DEVICE_WAIT_S
+    tpu_env.setenv("P2P_LONG_DEVICE_WAIT_S", "12.5")
+    assert plat.long_device_wait_s() == 12.5
+    tpu_env.setenv("P2P_LONG_DEVICE_WAIT_S", "nan")
+    assert plat.long_device_wait_s() == plat.LONG_DEVICE_WAIT_S
+    assert "ignoring invalid P2P_LONG_DEVICE_WAIT_S" in capsys.readouterr().err
+
+
 def test_invalid_env_does_not_clobber_explicit_budget(tpu_env):
     # nan would defeat every deadline comparison; an explicit caller
     # budget must survive an unparsable env value.

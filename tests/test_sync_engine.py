@@ -182,7 +182,7 @@ def test_snapshots_all_past_horizon_empty_list():
 
 
 def test_serialization_delay_model_parity_and_math():
-    """Serialization delay = latency + ceil(size*8/bandwidth/tick_dt)
+    """Serialization delay = round((latency + size*8/bandwidth)/tick_dt)
     (reference: 5 Mbps p2p links, p2pnetwork.cc:113); event and sync
     engines agree on the resulting integer-tick delay lines."""
     import pytest
@@ -192,16 +192,18 @@ def test_serialization_delay_model_parity_and_math():
 
     g = pg.erdos_renyi(60, 0.1, seed=4)
     # Reference config: 30-byte shares at 5 Mbps, 5 ms ticks -> 48 us
-    # serialization, quantized up to one extra tick of delay.
+    # serialization on a 5 ms latency = 5.048 ms, which rounds to the
+    # same 1 tick/hop the reference effectively has (NOT quantized up —
+    # that would silently double the default per-hop delay).
     d = serialization_delays(
         g, message_bytes=30, bandwidth_mbps=5.0, tick_dt=0.005
     )
-    assert int(d.min()) == int(d.max()) == 2  # 1 latency + 1 serialization
+    assert int(d.min()) == int(d.max()) == 1
     # A payload filling >1 tick of link time adds proportionally.
     d_big = serialization_delays(
         g, message_bytes=8_000, bandwidth_mbps=5.0, tick_dt=0.005
     )
-    # 8000 B * 8 / 5e6 = 12.8 ms = 2.56 ticks -> ceil 3, + 1 latency.
+    # 5 ms latency + 8000 B * 8 / 5e6 = 12.8 ms -> 17.8 ms -> 4 ticks.
     assert int(d_big.max()) == 4
     # Zero-size messages cost latency only.
     d0 = serialization_delays(
