@@ -177,8 +177,11 @@ def main() -> None:
                 "achieved_gbps": round(achieved_gbps, 1),
                 "pct_hbm_peak": (
                     round(100 * achieved_gbps / peak_gbps, 1)
-                    if not cpu_fallback
-                    else None  # host run: the TPU peak is meaningless
+                    if not (cpu_fallback or smoke)
+                    # Host run: the TPU peak is meaningless. Smoke run:
+                    # tiny shapes can't saturate HBM, the % would be
+                    # ingested as a real roofline figure.
+                    else None
                 ),
                 "ticks": ticks,
             }

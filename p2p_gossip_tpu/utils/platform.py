@@ -94,6 +94,34 @@ def device_wait_budget_s() -> float | None:
     return _parse_wait_env("P2P_DEVICE_WAIT_S")
 
 
+#: The device probe: backend init + a tiny on-device reduction. One
+#: definition, shared by wait_for_device and the on-chip battery's
+#: inter-stage health gate, so "healthy" means the same thing everywhere.
+DEVICE_PROBE_SNIPPET = (
+    "import jax, jax.numpy as jnp; jax.devices(); "
+    "print(float(jnp.sum(jnp.ones((128, 128)))))"
+)
+
+
+def run_device_probe(
+    timeout_s: float, env: dict | None = None
+) -> tuple[bool, str]:
+    """One killable-subprocess device probe. Returns (ok, err_tail) —
+    err_tail is the failure's stderr tail (or exception name) for logs."""
+    import subprocess
+    import sys
+
+    try:
+        subprocess.run(
+            [sys.executable, "-c", DEVICE_PROBE_SNIPPET],
+            check=True, timeout=timeout_s, capture_output=True, env=env,
+        )
+        return True, ""
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
+        err = (getattr(e, "stderr", b"") or b"").decode(errors="replace")
+        return False, f"{type(e).__name__}: ...{err.strip()[-400:]}"
+
+
 def wait_for_device(
     attempts: int | None = None,
     probe_timeout: int = 180,
@@ -156,10 +184,7 @@ def wait_for_device(
             f"{n_probes} probes) — tunnel still unreachable"
         )
 
-    probe = (
-        "import jax, jax.numpy as jnp; jax.devices(); "
-        "print(float(jnp.sum(jnp.ones((128, 128)))))"
-    )
+    probe = DEVICE_PROBE_SNIPPET
     attempt = 0
     while True:
         remaining = deadline - time.monotonic()

@@ -1,0 +1,140 @@
+"""Multi-chip 1M-mechanics rehearsal on a virtual CPU mesh (VERDICT r2 #6).
+
+Multi-chip TPU hardware is not attached in this environment, so perf
+cannot be measured — but the full BASELINE config-5 *mechanics* can be
+proven end-to-end at real scale on an 8-virtual-device CPU mesh: build a
+>=100K-node graph, run the sharded flood-coverage engine with lognormal
+per-edge delay lines under BOTH history-ring layouts, and check
+
+  - bitwise counter + coverage parity against the single-device engine,
+  - per-chip ring bytes scale 1/shards in sharded mode,
+
+so the only untested step to a physical v5e-8 is the hardware itself.
+
+Emits one JSON row per (N, ring_mode) on stdout; diagnostics on stderr.
+Usage: python scripts/mesh_rehearsal.py [--nodes 100000] [--prob 0.001]
+       [--shares 64] [--devices 8] [--skip-parity]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# Self-locate (PYTHONPATH must stay off the repo — scale_1m.py header).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=100_000)
+    ap.add_argument("--prob", type=float, default=0.001)
+    ap.add_argument("--shares", type=int, default=64)
+    ap.add_argument("--horizon", type=int, default=48)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--block", type=int, default=8)
+    ap.add_argument(
+        "--delay-max-ticks", type=int, default=4,
+        help="lognormal delay cap (distinct delay values L <= cap)",
+    )
+    ap.add_argument(
+        "--skip-parity", action="store_true",
+        help="skip the single-device parity run (halves the wall time)",
+    )
+    args = ap.parse_args()
+
+    # Virtual mesh: this is a mechanics rehearsal, so CPU is the point —
+    # pin it before jax loads and fan the host out to N devices.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+    from p2p_gossip_tpu.utils.platform import force_cpu_backend_if_requested
+
+    force_cpu_backend_if_requested()
+
+    import jax
+    import numpy as np
+
+    import p2p_gossip_tpu as pg
+    from p2p_gossip_tpu.engine.sync import run_flood_coverage
+    from p2p_gossip_tpu.models.latency import lognormal_delays
+    from p2p_gossip_tpu.parallel.engine_sharded import (
+        run_sharded_flood_coverage,
+    )
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+    from p2p_gossip_tpu.runtime import native
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= args.devices, devices
+    mesh = make_mesh(args.devices, 1, devices=devices[: args.devices])
+
+    t0 = time.perf_counter()
+    graph = native.native_erdos_renyi(args.nodes, args.prob, seed=args.seed)
+    if graph is None:
+        graph = pg.erdos_renyi(args.nodes, args.prob, seed=args.seed)
+    log(
+        f"graph: N={graph.n} edges={graph.num_edges} dmax={graph.max_degree}"
+        f" ({time.perf_counter() - t0:.1f}s)"
+    )
+    delays = lognormal_delays(
+        graph, mean_ticks=2.0, sigma=0.6, max_ticks=args.delay_max_ticks,
+        seed=args.seed,
+    )
+    n_delay_values = len(np.unique(delays[graph.ell()[1]]))
+    rng = np.random.default_rng(args.seed)
+    origins = rng.integers(0, graph.n, args.shares).astype(np.int32)
+
+    cov_single = None
+    if not args.skip_parity:
+        t0 = time.perf_counter()
+        stats_1, cov_single = run_flood_coverage(
+            graph, origins, args.horizon, ell_delays=delays, block=args.block,
+        )
+        log(f"single-device run: {time.perf_counter() - t0:.1f}s")
+
+    for ring_mode in ("replicated", "sharded"):
+        t0 = time.perf_counter()
+        stats_m, cov_m = run_sharded_flood_coverage(
+            graph, origins, args.horizon, mesh, ell_delays=delays,
+            block=args.block, ring_mode=ring_mode,
+        )
+        wall = time.perf_counter() - t0
+        ring = stats_m.extra["ring"]
+        parity = None
+        if cov_single is not None:
+            parity = bool(
+                np.array_equal(cov_single, cov_m)
+                and stats_m.equal_counts(stats_1)
+            )
+            assert parity, f"mesh diverges from single-device ({ring_mode})"
+        row = {
+            "rehearsal": "sharded_flood_coverage",
+            "nodes": graph.n,
+            "edges": graph.num_edges,
+            "devices": args.devices,
+            "shares": args.shares,
+            "delay_values": int(n_delay_values),
+            "ring_mode": ring["mode"],
+            "ring_slots": ring["slots"],
+            "ring_bytes_per_chip": ring["bytes_per_chip"],
+            "coverage_final_min": int(np.asarray(cov_m)[-1].min()),
+            "parity_vs_single_device": parity,
+            "wall_s": round(wall, 1),
+        }
+        log(f"{ring_mode}: ring {ring['bytes_per_chip']} B/chip, "
+            f"wall {wall:.1f}s, parity {parity}")
+        print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,334 @@
+"""One-shot on-chip evidence battery (VERDICT round-2 item #1).
+
+Two rounds of on-chip evidence have been lost to TPU-tunnel downtime: the
+tunnel answers rarely, a worker crash wedges it for ~1h+, and each manual
+run pays its own device wait and can kill the window for the next. This
+script converts ONE tunnel-up window into every artifact the judge needs,
+in safest-first order, persisting each stage's results the moment the
+stage completes — a crash in stage k cannot cost stages 1..k-1.
+
+Stages (safest first; the known-crashy 1M run goes last by design):
+
+  bench    — bench.py on the real chip       -> the BENCH_r03 headline JSON
+  kernel   — kernel_bench.py at 100K rows    -> Pallas-vs-XLA A/B table
+  sweep250 — kernel_bench.py --rows 250000   -> coverage/tick A/B at 250K
+  sweep500 — kernel_bench.py --rows 500000      (the 1M-crash bisection,
+  sweep1m  — kernel_bench.py --rows 1000000      one process per row count
+                                                so a crash is attributable)
+  scale1m  — scale_1m.py --cache --block 8   -> the 1M north-star JSON line
+
+Between stages a short health probe checks the tunnel still answers; a
+failed probe aborts the battery (later stages would only burn the wedge
+clock) and records why. Each stage runs in its own subprocess with its
+own wall budget, with PYTHONPATH stripped (it breaks the axon plugin's
+helper subprocess — see scripts/scale_1m.py header).
+
+Artifacts: one JSONL record per stage appended to
+docs/artifacts/battery_<UTC>.jsonl as each stage finishes (plus a
+'battery_latest.jsonl' copy), and a one-line summary JSON on stdout.
+
+Usage:
+  python scripts/onchip_battery.py                 # full battery
+  python scripts/onchip_battery.py --stages bench,kernel
+  python scripts/onchip_battery.py --smoke         # tiny CPU shapes, CI
+"""
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+from datetime import datetime, timezone
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO, "scripts")
+ART_DIR = os.path.join(REPO, "docs", "artifacts")
+
+STAGE_ORDER = ("bench", "kernel", "sweep250", "sweep500", "sweep1m", "scale1m")
+
+
+def log(msg: str) -> None:
+    print(f"[battery] {msg}", file=sys.stderr, flush=True)
+
+
+def stage_env(extra: dict | None = None) -> dict:
+    """Subprocess env with REPO entries filtered out of PYTHONPATH, plus
+    stage-specific overrides.
+
+    Two constraints pull in opposite directions: repo paths on PYTHONPATH
+    break the axon plugin's helper subprocess ("Backend 'axon' is not in
+    the list of known backends" — scripts/scale_1m.py header), but the
+    plugin itself registers FROM PYTHONPATH (this box exports
+    PYTHONPATH=/root/.axon_site), so stripping the variable wholesale
+    kills the TPU backend in every child. Filter, don't delete."""
+    env = dict(os.environ)
+    pp = env.get("PYTHONPATH")
+    if pp is not None:
+        kept = [
+            p for p in pp.split(os.pathsep)
+            if p and not (
+                os.path.abspath(p) == REPO
+                or os.path.abspath(p).startswith(REPO + os.sep)
+            )
+        ]
+        if kept:
+            env["PYTHONPATH"] = os.pathsep.join(kept)
+        else:
+            del env["PYTHONPATH"]
+    if extra:
+        env.update(extra)
+    return env
+
+
+def tunnel_healthy(probe_timeout_s: float = 150.0) -> bool:
+    """THE device probe (platform.run_device_probe — the same definition
+    wait_for_device retries), so the battery's abort decisions can't
+    drift from what the stages themselves wait for."""
+    from p2p_gossip_tpu.utils.platform import run_device_probe
+
+    ok, err = run_device_probe(probe_timeout_s, env=stage_env())
+    if not ok:
+        log(f"health probe failed: {err}")
+    return ok
+
+
+def stage_specs(args) -> dict:
+    """argv + env + budget per stage. Smoke mode swaps in tiny CPU shapes
+    so the battery's own machinery is testable without a chip."""
+    py = sys.executable
+    if args.smoke:
+        # All smoke stages pin CPU: wait_for_device no-ops there, so the
+        # battery machinery is exercised with zero tunnel dependency.
+        cpu = {"JAX_PLATFORMS": "cpu"}
+        kb_small = [
+            py, os.path.join(SCRIPTS, "kernel_bench.py"),
+            "--rows", "2000", "--words", "8", "--iters", "3",
+        ]
+        return {
+            "bench": {
+                "argv": [py, os.path.join(REPO, "bench.py")],
+                "env": {**cpu, "P2P_BENCH_SMOKE": "1"},
+                "budget": args.stage_budget or 900,
+            },
+            "kernel": {
+                "argv": kb_small,
+                "env": cpu,
+                "budget": args.stage_budget or 600,
+            },
+            # Smoke sweeps stay tiny: the point is the battery's
+            # per-process isolation machinery, not the row counts.
+            "sweep250": {
+                "argv": kb_small + ["--skip-gather"],
+                "env": cpu,
+                "budget": args.stage_budget or 600,
+            },
+            "sweep500": {
+                "argv": kb_small + ["--skip-gather"],
+                "env": cpu,
+                "budget": args.stage_budget or 600,
+            },
+            "sweep1m": {
+                "argv": kb_small + ["--skip-gather"],
+                "env": cpu,
+                "budget": args.stage_budget or 600,
+            },
+            "scale1m": {
+                "argv": [
+                    py, os.path.join(SCRIPTS, "scale_1m.py"),
+                    "--nodes", "2000", "--prob", "0.01", "--shares", "64",
+                    "--horizon", "32", "--block", "8",
+                ],
+                "env": cpu,
+                "budget": args.stage_budget or 900,
+            },
+        }
+    kb = [py, os.path.join(SCRIPTS, "kernel_bench.py")]
+    # Bound every stage's device wait WELL inside its wall budget: the
+    # battery only starts a stage after a healthy probe, so a long
+    # in-stage wait means a fresh wedge and the budget should go to the
+    # next health probe, not to waiting. Both knobs are set because
+    # kernel_bench reads P2P_DEVICE_WAIT_S (no explicit budget) while
+    # scale_1m's explicit long budget reads P2P_LONG_DEVICE_WAIT_S —
+    # and both OVERRIDE any operator export for the child process.
+    sweep_env = {
+        "P2P_DEVICE_WAIT_S": "600",
+        "P2P_LONG_DEVICE_WAIT_S": "600",
+    }
+    return {
+        "bench": {
+            "argv": [py, os.path.join(REPO, "bench.py")],
+            # Bound the wait: the battery only starts a stage after a
+            # healthy probe, so a long in-stage wait means a fresh wedge.
+            "env": {"P2P_DEVICE_WAIT_S": "600"},
+            "budget": args.stage_budget or 1800,
+        },
+        "kernel": {
+            "argv": kb + ["--rows", "100000"],
+            "env": sweep_env,
+            "budget": args.stage_budget or 1800,
+        },
+        "sweep250": {
+            "argv": kb + ["--rows", "250000", "--skip-gather"],
+            "env": sweep_env,
+            "budget": args.stage_budget or 1500,
+        },
+        "sweep500": {
+            "argv": kb + ["--rows", "500000", "--skip-gather"],
+            "env": sweep_env,
+            "budget": args.stage_budget or 1500,
+        },
+        "sweep1m": {
+            "argv": kb + ["--rows", "1000000", "--skip-gather"],
+            "env": sweep_env,
+            "budget": args.stage_budget or 1800,
+        },
+        "scale1m": {
+            "argv": [
+                py, os.path.join(SCRIPTS, "scale_1m.py"),
+                "--cache", args.cache, "--block", str(args.block),
+            ],
+            "env": sweep_env,
+            "budget": args.stage_budget or 3600,
+        },
+    }
+
+
+def run_stage(name: str, spec: dict) -> dict:
+    """Run one stage to completion (or budget/crash) and return its
+    record. stdout lines that parse as JSON are the stage's results."""
+    t0 = time.monotonic()
+    log(f"stage {name}: {' '.join(spec['argv'])} (budget {spec['budget']}s)")
+    try:
+        proc = subprocess.run(
+            spec["argv"], timeout=spec["budget"], capture_output=True,
+            text=True, env=stage_env(spec["env"]), cwd=REPO,
+        )
+        rc: int | str = proc.returncode
+        out, err = proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        rc = "timeout"
+        out = (e.stdout or b"").decode(errors="replace") if isinstance(
+            e.stdout, bytes) else (e.stdout or "")
+        err = (e.stderr or b"").decode(errors="replace") if isinstance(
+            e.stderr, bytes) else (e.stderr or "")
+    wall = time.monotonic() - t0
+    results, raw = [], []
+    for line in out.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            results.append(json.loads(line))
+        except json.JSONDecodeError:
+            raw.append(line)
+    rec = {
+        "stage": name,
+        "argv": spec["argv"],
+        "rc": rc,
+        "ok": rc == 0,
+        "wall_s": round(wall, 1),
+        "results": results,
+        "stdout_nonjson": raw[-5:],
+        "stderr_tail": err[-1500:],
+        "utc": datetime.now(timezone.utc).isoformat(timespec="seconds"),
+    }
+    log(f"stage {name}: rc={rc} wall={wall:.0f}s results={len(results)}")
+    return rec
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--stages", default=",".join(STAGE_ORDER),
+        help=f"comma list from {STAGE_ORDER}, run in canonical order",
+    )
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="tiny CPU shapes: tests the battery machinery, not the chip",
+    )
+    ap.add_argument(
+        "--stage-budget", type=int, default=0,
+        help="override every stage's wall budget (seconds; 0 = defaults)",
+    )
+    ap.add_argument("--cache", default="/tmp/er1m.npz",
+                    help="graph cache for the scale1m stage")
+    ap.add_argument("--block", type=int, default=8,
+                    help="degree block for the scale1m stage")
+    ap.add_argument(
+        "--no-probe", action="store_true",
+        help="skip inter-stage health probes (smoke/CPU runs)",
+    )
+    ap.add_argument(
+        "--art-dir", default=os.environ.get("P2P_BATTERY_DIR", ART_DIR),
+        help="artifact directory (default docs/artifacts; real on-chip "
+        "runs commit theirs, tests point this at a tmp dir)",
+    )
+    args = ap.parse_args()
+
+    wanted = [s.strip() for s in args.stages.split(",") if s.strip()]
+    unknown = [s for s in wanted if s not in STAGE_ORDER]
+    if unknown:
+        print(f"error: unknown stages {unknown}; valid: {STAGE_ORDER}",
+              file=sys.stderr)
+        return 2
+    stages = [s for s in STAGE_ORDER if s in wanted]
+    specs = stage_specs(args)
+    probing = not (args.no_probe or args.smoke)
+
+    os.makedirs(args.art_dir, exist_ok=True)
+    stamp = datetime.now(timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    art_path = os.path.join(args.art_dir, f"battery_{stamp}.jsonl")
+    latest = os.path.join(args.art_dir, "battery_latest.jsonl")
+
+    def persist(rec: dict) -> None:
+        # Append + copy-to-latest after EVERY stage: a later worker crash
+        # (or a kill of this process) keeps everything already measured.
+        with open(art_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        shutil.copyfile(art_path, latest)
+
+    summary = {"artifact": art_path, "stages": {}, "aborted": None}
+    if probing and not tunnel_healthy():
+        summary["aborted"] = "tunnel unhealthy before first stage"
+        persist({"stage": "_abort", "reason": summary["aborted"],
+                 "utc": datetime.now(timezone.utc).isoformat(
+                     timespec="seconds")})
+        print(json.dumps(summary))
+        return 1
+
+    for i, name in enumerate(stages):
+        rec = run_stage(name, specs[name])
+        persist(rec)
+        summary["stages"][name] = {"ok": rec["ok"], "rc": rec["rc"]}
+        remaining = stages[i + 1:]
+        if remaining and probing:
+            # A stage that just crashed the worker leaves the tunnel
+            # wedged for ~1h; probing now (and aborting on failure) keeps
+            # the already-persisted artifacts instead of queueing every
+            # later stage behind a dead tunnel.
+            if not tunnel_healthy():
+                summary["aborted"] = (
+                    f"tunnel unhealthy after stage {name}; "
+                    f"skipped {remaining}"
+                )
+                log(summary["aborted"])
+                persist({"stage": "_abort", "reason": summary["aborted"],
+                         "utc": datetime.now(timezone.utc).isoformat(
+                             timespec="seconds")})
+                break
+    print(json.dumps(summary))
+    # Nonzero on abort OR any failed stage: automation watching this
+    # exit code must not read "tunnel stayed healthy" as "evidence
+    # captured" when every stage actually failed.
+    all_ok = all(s["ok"] for s in summary["stages"].values())
+    return 0 if summary["aborted"] is None and all_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,3 +48,36 @@ def test_protocol_compare_smoke_json():
     assert {"flood", "pushpull", "pull", "pushk"} <= protos
     # Strict JSON round-trip (the sends_per_delivery None contract).
     json.loads(json.dumps(payload))
+
+
+def test_onchip_battery_smoke(tmp_path):
+    """The battery must run a stage subset end-to-end in smoke mode,
+    persist one JSONL record per stage as it completes, and print a
+    parseable summary — this is the machinery that converts a scarce
+    tunnel-up window into artifacts, so its contract is tested harder
+    than its numbers."""
+    r = _run_script(
+        "onchip_battery.py", "--smoke", "--stages", "bench,scale1m",
+        "--art-dir", str(tmp_path), timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["aborted"] is None
+    assert summary["stages"] == {
+        "bench": {"ok": True, "rc": 0},
+        "scale1m": {"ok": True, "rc": 0},
+    }
+    with open(summary["artifact"]) as f:
+        records = [json.loads(line) for line in f]
+    assert [rec["stage"] for rec in records] == ["bench", "scale1m"]
+    for rec in records:
+        assert rec["ok"] and rec["results"], rec["stderr_tail"]
+    # The bench stage's JSON line must be the bench.py contract.
+    bench_row = records[0]["results"][-1]
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(bench_row)
+
+
+def test_onchip_battery_rejects_unknown_stage():
+    r = _run_script("onchip_battery.py", "--stages", "bench,nope")
+    assert r.returncode == 2
+    assert "unknown stages" in r.stderr
