@@ -172,13 +172,19 @@ def _forced_edges(n: int, has_upper_edge: np.ndarray) -> np.ndarray:
     return np.array(out, dtype=np.int64).reshape(-1, 2)
 
 
-def erdos_renyi(n: int, p: float, seed: int = 0) -> Graph:
+def erdos_renyi(
+    n: int, p: float, seed: int = 0, return_parallel_extra: bool = False
+):
     """Erdős–Rényi G(n, p) with the reference's connectivity fix.
 
     Parity target: CreateRandomTopology (p2pnetwork.cc:62-96) — upper-triangle
     Bernoulli(p) sampling plus forced edges. Dense sampling for small n;
     per-row binomial sampling (identical distribution) for large n so that
     million-node graphs build without an O(n^2) bit matrix.
+
+    ``return_parallel_extra`` additionally returns the (n,) int32 vector of
+    duplicate-peer-list entries the reference's parallel-link quirk would
+    produce (see ``parallel_link_extra``): returns ``(graph, extra)``.
     """
     if n <= 0:
         raise ValueError("n must be positive")
@@ -202,10 +208,60 @@ def erdos_renyi(n: int, p: float, seed: int = 0) -> Graph:
             if srcs
             else np.zeros((0, 2), dtype=np.int64)
         )
-    forced = _forced_edges(n, has_upper)
-    if forced.size:
-        edges = np.concatenate([edges, forced], axis=0)
-    return Graph.from_edges(n, edges)
+    graph = Graph.from_edges(
+        n, np.concatenate([edges, _forced_edges(n, has_upper)], axis=0)
+    )
+    if not return_parallel_extra:
+        return graph
+    return graph, parallel_link_extra(n, edges, has_upper)
+
+
+def parallel_link_extra(
+    n: int, sampled_edges: np.ndarray, has_upper: np.ndarray
+) -> np.ndarray:
+    """Per-node duplicate peer-list entries under the reference's
+    parallel-link quirk (the deviation SURVEY §1 documents; modeled here
+    behind the CLI's ``--refParallelLinks`` flag).
+
+    The reference keys its link map by the ORDERED pair passed to
+    `ConnectNodes` (p2pnetwork.cc:129): a sampled edge is (i-1, i) while
+    row i's forced fallback is (i, i-1) (p2pnetwork.cc:83) — different
+    keys, so both physical links are built. `makeconnections` then opens
+    sockets for every map entry (p2pnetwork.cc:98-106): the synchronous
+    `AddPeer` is deduplicated (p2pnode.cc:77-82) but the REGISTER reply
+    handler appends without a membership check (p2pnode.cc:186), so BOTH
+    endpoints of a doubled pair end with the other listed twice and
+    every later broadcast sends that peer two copies (p2pnode.cc:129).
+    The receiver's seen-set drops the second copy without touching any
+    counter (p2pnode.cc:189-193), so the quirk's only observable effects
+    are per-broadcast double `sent` on those entries and an inflated
+    "Peer count" stat (`peers.size()`, while "Socket connections" stays
+    deduplicated — `peersockets` is a map, p2pnode.cc:248).
+
+    A pair {i-1, i} is doubled iff row i forced its fallback edge AND the
+    (i-1, i) key exists — sampled by row i-1, or (for i == 1) forced by
+    row 0's own fallback (0, 1).
+    """
+    extra = np.zeros(n, dtype=np.int32)
+    if n <= 1:
+        return extra
+    forced_rows = np.flatnonzero(~has_upper)
+    forced_rows = forced_rows[forced_rows >= 1]
+    if forced_rows.size == 0:
+        return extra
+    sampled_edges = np.asarray(sampled_edges, dtype=np.int64).reshape(-1, 2)
+    sampled_keys = set(
+        (sampled_edges[:, 0] * n + sampled_edges[:, 1]).tolist()
+    )
+    for i in forced_rows:
+        i = int(i)
+        second = ((i - 1) * n + i) in sampled_keys or (
+            i == 1 and not has_upper[0]
+        )
+        if second:
+            extra[i - 1] += 1
+            extra[i] += 1
+    return extra
 
 
 def barabasi_albert(n: int, m: int = 3, seed: int = 0, batch: int = 1024) -> Graph:

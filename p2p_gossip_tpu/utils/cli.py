@@ -77,6 +77,18 @@ def build_parser() -> argparse.ArgumentParser:
         "Watts-Strogatz small-world; grid/torus = 2D lattice)",
     )
     p.add_argument(
+        "--refParallelLinks", action="store_true",
+        help="Model the reference's parallel-link REGISTER quirk: when a "
+        "forced connectivity edge duplicates a sampled one, both endpoints "
+        "list each other twice and every broadcast sends the duplicate an "
+        "extra copy (dropped by its seen-set on arrival, so dynamics are "
+        "unchanged). Reproduces the reference's inflated Total-sent and "
+        "Peer-count numbers exactly (p2pnetwork.cc:83,129; p2pnode.cc:186); "
+        "off by default because it models a reference bug, not a "
+        "capability. er topology with the python graph builder only (the "
+        "quirk depends on the builder's sampling stream).",
+    )
+    p.add_argument(
         "--graphBuilder", choices=("auto", "native", "python"),
         default="python",
         help="Graph construction path for er/ba: the C++ builder "
@@ -459,11 +471,37 @@ def run(argv=None) -> int:
                 )
                 return 2
 
+    if args.refParallelLinks and (
+        args.topology != "er" or loaded_graph is not None
+    ):
+        print(
+            "error: --refParallelLinks needs a freshly built er topology "
+            "(the quirk depends on which forced edges duplicate sampled "
+            "ones in the builder's own sampling stream)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.refParallelLinks and args.graphBuilder == "native":
+        print(
+            "error: --refParallelLinks requires --graphBuilder python "
+            "(the native builder uses a different RNG stream)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.refParallelLinks and args.protocol != "push":
+        print(
+            "error: --refParallelLinks models the reference's broadcast "
+            "quirk; it only applies to --protocol push (flood)",
+            file=sys.stderr,
+        )
+        return 2
+
     use_native_builder = False
     if (
         loaded_graph is None
         and args.graphBuilder != "python"
         and args.topology in ("er", "ba")
+        and not args.refParallelLinks
     ):
         from p2p_gossip_tpu.runtime import native as native_rt
 
@@ -484,18 +522,23 @@ def run(argv=None) -> int:
         )
         return 2
 
+    parallel_extra = None
     if loaded_graph is not None:
         g = loaded_graph
     elif args.topology == "er":
-        g = (
-            native_rt.native_erdos_renyi(
+        if use_native_builder:
+            g = native_rt.native_erdos_renyi(
                 args.numNodes, args.connectionProb, seed=args.seed
             )
-            if use_native_builder
-            else topo.erdos_renyi(
+        elif args.refParallelLinks:
+            g, parallel_extra = topo.erdos_renyi(
+                args.numNodes, args.connectionProb, seed=args.seed,
+                return_parallel_extra=True,
+            )
+        else:
+            g = topo.erdos_renyi(
                 args.numNodes, args.connectionProb, seed=args.seed
             )
-        )
     elif args.topology == "ba":
         g = (
             native_rt.native_barabasi_albert(
@@ -830,6 +873,17 @@ def run(argv=None) -> int:
             connect_tick=args.connectAtTick,
         )
     wall = time.perf_counter() - t0
+
+    if parallel_extra is not None:
+        # Pure reporting transform — the duplicate copies never change
+        # gossip dynamics (stats.with_parallel_links documents why).
+        stats = stats.with_parallel_links(parallel_extra)
+        n_dup = int((parallel_extra > 0).sum())
+        print(
+            f"parallel-link quirk: {int(parallel_extra.sum()) // 2} doubled "
+            f"pair(s) across {n_dup} node(s)",
+            file=sys.stderr,
+        )
 
     # Periodic reports (PrintPeriodicStats, p2pnetwork.cc:201-204): exact
     # mid-run snapshots (all push backends; push-pull has no snapshot path).

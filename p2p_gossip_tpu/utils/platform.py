@@ -150,7 +150,6 @@ def wait_for_device(
     Used by the benchmark/experiment scripts before their first device
     query; diagnostics go to stderr.
     """
-    import subprocess
     import sys
     import time
 
@@ -184,36 +183,28 @@ def wait_for_device(
             f"{n_probes} probes) — tunnel still unreachable"
         )
 
-    probe = DEVICE_PROBE_SNIPPET
     attempt = 0
     while True:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             raise budget_exhausted(attempt)
-        try:
-            subprocess.run(
-                [sys.executable, "-c", probe],
-                check=True, timeout=min(probe_timeout, remaining),
-                capture_output=True,
-            )
+        ok, err = run_device_probe(min(probe_timeout, remaining))
+        if ok:
             return
-        except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
-            attempt += 1
-            err = (getattr(e, "stderr", b"") or b"").decode(
-                errors="replace"
-            ).strip()
-            print(
-                f"device probe attempt {attempt} failed: "
-                f"{type(e).__name__}: ...{err[-400:]} "
-                f"(budget left {max(0.0, deadline - time.monotonic()):.0f}s)",
-                file=sys.stderr, flush=True,
+        attempt += 1
+        print(
+            f"device probe attempt {attempt} failed: {err} "
+            f"(budget left {max(0.0, deadline - time.monotonic()):.0f}s)",
+            file=sys.stderr, flush=True,
+        )
+        if attempts is not None and attempt >= attempts:
+            raise TimeoutError(
+                f"device unreachable after {attempt} probe attempt(s): {err}"
             )
-            if attempts is not None and attempt >= attempts:
-                raise
-            # Sleep before retrying, but never sleep the budget away: leave
-            # headroom for at least one more probe after waking, else the
-            # caller's fallback is delayed by a sleep nothing can follow.
-            sleep_s = min(60.0, deadline - time.monotonic() - 5.0)
-            if sleep_s <= 0:
-                raise budget_exhausted(attempt)
-            time.sleep(sleep_s)
+        # Sleep before retrying, but never sleep the budget away: leave
+        # headroom for at least one more probe after waking, else the
+        # caller's fallback is delayed by a sleep nothing can follow.
+        sleep_s = min(60.0, deadline - time.monotonic() - 5.0)
+        if sleep_s <= 0:
+            raise budget_exhausted(attempt)
+        time.sleep(sleep_s)

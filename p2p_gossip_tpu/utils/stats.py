@@ -42,10 +42,38 @@ class NodeStats:
         }
 
     def check_conservation(self) -> None:
-        """Invariants implied by the reference semantics (see SURVEY.md §1)."""
+        """Invariants implied by the reference semantics (see SURVEY.md §1).
+        Under the parallel-link quirk (``with_parallel_links``) each
+        broadcast also sends one copy per duplicated peer-list entry."""
         assert (self.received == self.forwarded).all(), "received != forwarded"
         assert (self.processed == self.generated + self.received).all()
-        assert (self.sent == (self.generated + self.forwarded) * self.degree).all()
+        fan = self.degree + self.extra.get("peer_extra", 0)
+        assert (self.sent == (self.generated + self.forwarded) * fan).all()
+
+    def with_parallel_links(self, peer_extra: np.ndarray) -> "NodeStats":
+        """Counters under the reference's parallel-link REGISTER quirk
+        (`models.topology.parallel_link_extra` explains the mechanism and
+        cites the reference lines). The quirk does not change the gossip
+        dynamics — the duplicate copy arrives the same tick and is
+        dropped by the seen-set without touching any counter
+        (p2pnode.cc:189-193) — so it is applied as a pure reporting
+        transform: each broadcast charges one extra `sent` per duplicated
+        peer-list entry (p2pnode.cc:129-146), and "Peer count" prints
+        `peers.size()` including duplicates while "Socket connections"
+        stays deduplicated (p2pnode.cc:248)."""
+        peer_extra = np.asarray(peer_extra, dtype=self.sent.dtype)
+        assert peer_extra.shape == self.sent.shape
+        out = NodeStats(
+            generated=self.generated,
+            received=self.received,
+            forwarded=self.forwarded,
+            sent=self.sent + (self.generated + self.forwarded) * peer_extra,
+            processed=self.processed,
+            degree=self.degree,
+            extra=dict(self.extra),
+        )
+        out.extra["peer_extra"] = peer_extra
+        return out
 
     def __add__(self, other: "NodeStats") -> "NodeStats":
         """Chunk-wise accumulation (shares are independent, counters add).
@@ -86,6 +114,9 @@ def format_final_statistics(stats: NodeStats, per_node: bool = True) -> str:
     field layout (socket connections == peer count in a healthy run)."""
     out = io.StringIO()
     out.write("=== P2P Gossip Network Simulation Statistics ===\n")
+    # Peer count = peers.size() — inflated by the parallel-link quirk when
+    # modeled; socket connections = the deduplicated peersockets map.
+    peer_count = stats.degree + stats.extra.get("peer_extra", 0)
     if per_node:
         for i in range(stats.n):
             out.write(
@@ -94,7 +125,7 @@ def format_final_statistics(stats: NodeStats, per_node: bool = True) -> str:
                 f", Forwarded {stats.forwarded[i]}"
                 f", Total sent {stats.sent[i]}"
                 f", Total processed {stats.processed[i]}"
-                f", Peer count {stats.degree[i]}"
+                f", Peer count {peer_count[i]}"
                 f", Socket connections {stats.degree[i]}\n"
             )
     t = stats.totals()

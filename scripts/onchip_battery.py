@@ -293,12 +293,20 @@ def main() -> int:
             os.fsync(f.fileno())
         shutil.copyfile(art_path, latest)
 
+    def abort_record(reason: str) -> dict:
+        # Same schema as stage records so artifact consumers can iterate
+        # uniformly — aborted batteries are exactly when the trail matters.
+        return {
+            "stage": "_abort", "argv": [], "rc": "abort", "ok": False,
+            "wall_s": 0.0, "results": [], "stdout_nonjson": [],
+            "stderr_tail": reason,
+            "utc": datetime.now(timezone.utc).isoformat(timespec="seconds"),
+        }
+
     summary = {"artifact": art_path, "stages": {}, "aborted": None}
     if probing and not tunnel_healthy():
         summary["aborted"] = "tunnel unhealthy before first stage"
-        persist({"stage": "_abort", "reason": summary["aborted"],
-                 "utc": datetime.now(timezone.utc).isoformat(
-                     timespec="seconds")})
+        persist(abort_record(summary["aborted"]))
         print(json.dumps(summary))
         return 1
 
@@ -318,9 +326,7 @@ def main() -> int:
                     f"skipped {remaining}"
                 )
                 log(summary["aborted"])
-                persist({"stage": "_abort", "reason": summary["aborted"],
-                         "utc": datetime.now(timezone.utc).isoformat(
-                             timespec="seconds")})
+                persist(abort_record(summary["aborted"]))
                 break
     print(json.dumps(summary))
     # Nonzero on abort OR any failed stage: automation watching this

@@ -436,3 +436,53 @@ def test_flood_coverage_json(capsys):
     assert payload["ttc_ticks"]["min"] >= 1
     assert payload["final_coverage"]["max"] == 60
     assert payload["sends_per_delivery"] > 1
+
+
+def test_ref_parallel_links_flag():
+    """--refParallelLinks inflates Total sent and Peer count exactly as the
+    reference's doubled forced edges would, identically across backends,
+    without changing dynamics (Received/Forwarded/Processed)."""
+    common = [
+        "--numNodes", "14", "--connectionProb", "0.12", "--simTime", "6",
+        "--Latency", "5", "--seed", "2",  # seed 2: nodes 7+8 doubled
+    ]
+    base = _run_cli(*common, "--backend", "event")
+    ev = _run_cli(*common, "--backend", "event", "--refParallelLinks")
+    tp = _run_cli(*common, "--backend", "tpu", "--refParallelLinks")
+    assert base.returncode == 0 and ev.returncode == 0 and tp.returncode == 0
+
+    def node_fields(out):
+        rows = {}
+        for line in out.splitlines():
+            if line.startswith("Node "):
+                parts = line.replace(":", ",").split(",")
+                rows[int(parts[0].split()[1])] = [
+                    int(p.split()[-1]) for p in parts[1:]
+                ]
+        return rows
+
+    b, e = node_fields(base.stdout), node_fields(ev.stdout)
+    assert e == node_fields(tp.stdout)  # backend-identical under the quirk
+    assert "parallel-link quirk: 1 doubled pair(s)" in ev.stderr
+    changed = {i for i in b if b[i] != e[i]}
+    assert changed == {7, 8}
+    for i in (7, 8):
+        gen, rec, fwd, sent, proc, peers, socks = b[i]
+        gen2, rec2, fwd2, sent2, proc2, peers2, socks2 = e[i]
+        # Dynamics unchanged; sent charged one extra copy per broadcast;
+        # peer count (peers.size()) inflated, socket count (map) not.
+        assert (gen2, rec2, fwd2, proc2) == (gen, rec, fwd, proc)
+        assert sent2 == sent + (gen + fwd)
+        assert peers2 == peers + 1 and socks2 == socks == peers
+
+    # Guard rails: wrong topology / builder / protocol get clean errors.
+    bad = _run_cli(
+        "--numNodes", "10", "--connectionProb", "0.3", "--simTime", "2",
+        "--topology", "ring", "--refParallelLinks", "--backend", "event",
+    )
+    assert bad.returncode == 2 and "refParallelLinks" in bad.stderr
+    bad2 = _run_cli(
+        "--numNodes", "10", "--connectionProb", "0.3", "--simTime", "2",
+        "--refParallelLinks", "--protocol", "pushpull", "--backend", "event",
+    )
+    assert bad2.returncode == 2 and "flood" in bad2.stderr

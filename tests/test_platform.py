@@ -26,11 +26,11 @@ def tpu_env(monkeypatch):
 def _hang_probe(monkeypatch, calls):
     """Make every subprocess probe behave like a wedged tunnel."""
 
-    def fake_run(cmd, check, timeout, capture_output):
+    def fake_run(cmd, check, timeout, capture_output, env=None):
         calls.append(timeout)
         raise subprocess.TimeoutExpired(cmd, timeout)
 
-    # wait_for_device imports subprocess locally; patch the module itself.
+    # run_device_probe imports subprocess locally; patch the module itself.
     monkeypatch.setattr(subprocess, "run", fake_run)
 
 
@@ -125,7 +125,7 @@ def test_cpu_requested_is_noop(monkeypatch):
 
 
 def test_successful_probe_returns(tpu_env):
-    def fake_run(cmd, check, timeout, capture_output):
+    def fake_run(cmd, check, timeout, capture_output, env=None):
         return None
 
     tpu_env.setattr(subprocess, "run", fake_run)

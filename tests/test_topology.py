@@ -190,3 +190,84 @@ def test_ell_rows_matches_global_ell():
         full_mask = np.pad(full_mask, ((0, 0), (0, pad)))
     assert np.array_equal(sub_idx, full_idx[rows, :cap])
     assert np.array_equal(sub_mask, full_mask[rows, :cap])
+
+
+def _reference_peer_lists(n: int, p: float, seed: int):
+    """Oracle for the reference's parallel-link REGISTER quirk: replay
+    CreateRandomTopology (p2pnetwork.cc:62-96) + makeconnections
+    (p2pnetwork.cc:98-106) + the REGISTER handler (p2pnode.cc:178-186)
+    against the python builder's exact sampling stream, and return every
+    node's `peers` vector INCLUDING duplicates.
+
+    The reference's link map is keyed by the ordered pair passed to
+    ConnectNodes: sampled rows insert (i, j) with i < j; a forced
+    fallback inserts (i, i-1) (reversed!) or (0, 1). makeconnections
+    walks the map in key order calling the deduplicated AddPeer
+    synchronously (p2pnode.cc:77-82); each REGISTER packet is delivered
+    in a later simulator event, and its handler appends without a
+    membership check — so both endpoints of a doubled pair list each
+    other twice."""
+    rng = np.random.default_rng(seed)
+    tri = np.triu(rng.random((n, n)) < p, k=1)
+    keys = set()
+    for i in range(n):
+        for j in range(i + 1, n):
+            if tri[i, j]:
+                keys.add((i, j))
+        if not tri[i].any():
+            keys.add((0, 1) if i == 0 else (i, i - 1))
+    peers = {i: [] for i in range(n)}
+    for a, b in sorted(keys):  # sync phase: client-side AddPeer, dedup'd
+        if b not in peers[a]:
+            peers[a].append(b)
+    for a, b in sorted(keys):  # async phase: REGISTER push_back, no dedup
+        peers[b].append(a)
+    return peers
+
+
+def test_parallel_link_extra_matches_reference_oracle():
+    """parallel_link_extra = (reference peers.size()) - (unique degree),
+    for every node, across seeds with and without doubled pairs."""
+    from p2p_gossip_tpu.models.topology import parallel_link_extra
+
+    n, p = 14, 0.12
+    saw_dup = 0
+    for seed in range(60):
+        g, extra = erdos_renyi(n, p, seed=seed, return_parallel_extra=True)
+        oracle = _reference_peer_lists(n, p, seed)
+        want = np.array(
+            [len(oracle[i]) - len(set(oracle[i])) for i in range(n)],
+            dtype=np.int32,
+        )
+        assert np.array_equal(extra, want), f"seed {seed}: {extra} != {want}"
+        # The deduplicated peer set must be exactly the graph's adjacency.
+        for i in range(n):
+            assert sorted(set(oracle[i])) == sorted(
+                g.indices[g.indptr[i]:g.indptr[i + 1]].tolist()
+            ), f"seed {seed} node {i}"
+        saw_dup += int(extra.sum() > 0)
+    # The scan must actually exercise the quirk, not just the no-dup path.
+    assert saw_dup >= 3, f"only {saw_dup} seeds produced a doubled pair"
+
+
+def test_with_parallel_links_counters():
+    """The stats transform charges (generated+forwarded) extra sends per
+    duplicated entry and inflates Peer count but not Socket connections."""
+    from p2p_gossip_tpu.utils.stats import NodeStats, format_final_statistics
+
+    g = np.array([2, 0, 1], dtype=np.int64)
+    r = np.array([1, 3, 2], dtype=np.int64)
+    deg = np.array([2, 2, 2], dtype=np.int64)
+    stats = NodeStats(
+        generated=g, received=r, forwarded=r.copy(),
+        sent=(g + r) * deg, processed=g + r, degree=deg,
+    )
+    stats.check_conservation()
+    extra = np.array([1, 0, 1], dtype=np.int64)
+    adj = stats.with_parallel_links(extra)
+    adj.check_conservation()  # conservation aware of peer_extra
+    assert np.array_equal(adj.sent, (g + r) * (deg + extra))
+    text = format_final_statistics(adj)
+    assert "Peer count 3, Socket connections 2" in text
+    # Unadjusted rows keep peer count == socket count.
+    assert "Peer count 2, Socket connections 2" in text
