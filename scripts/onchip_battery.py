@@ -9,13 +9,15 @@ stage completes — a crash in stage k cannot cost stages 1..k-1.
 
 Stages (safest first; the known-crashy 1M run goes last by design):
 
-  bench    — bench.py on the real chip       -> the BENCH_r03 headline JSON
-  kernel   — kernel_bench.py at 100K rows    -> Pallas-vs-XLA A/B table
-  sweep250 — kernel_bench.py --rows 250000   -> coverage/tick A/B at 250K
-  sweep500 — kernel_bench.py --rows 500000      (the 1M-crash bisection,
-  sweep1m  — kernel_bench.py --rows 1000000      one process per row count
-                                                so a crash is attributable)
-  scale1m  — scale_1m.py --cache --block 8   -> the 1M north-star JSON line
+  bench     — bench.py on the real chip      -> the BENCH_r03 headline JSON
+  protocols — protocol_compare.py at 100K    -> flood/pushpull/pull/pushk table
+              (standard XLA engines, low risk — before any Pallas runs)
+  kernel    — kernel_bench.py at 100K rows   -> Pallas-vs-XLA A/B table
+  sweep250  — kernel_bench.py --rows 250000  -> coverage/tick A/B at 250K
+  sweep500  — kernel_bench.py --rows 500000     (the 1M-crash bisection,
+  sweep1m   — kernel_bench.py --rows 1000000     one process per row count
+                                                 so a crash is attributable)
+  scale1m   — scale_1m.py --cache --block 8  -> the 1M north-star JSON line
 
 Between stages a short health probe checks the tunnel still answers; a
 failed probe aborts the battery (later stages would only burn the wedge
@@ -48,7 +50,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPTS = os.path.join(REPO, "scripts")
 ART_DIR = os.path.join(REPO, "docs", "artifacts")
 
-STAGE_ORDER = ("bench", "kernel", "sweep250", "sweep500", "sweep1m", "scale1m")
+STAGE_ORDER = (
+    "bench", "protocols", "kernel", "sweep250", "sweep500", "sweep1m",
+    "scale1m",
+)
 
 
 def log(msg: str) -> None:
@@ -114,6 +119,15 @@ def stage_specs(args) -> dict:
                 "env": {**cpu, "P2P_BENCH_SMOKE": "1"},
                 "budget": args.stage_budget or 900,
             },
+            "protocols": {
+                "argv": [
+                    py, os.path.join(SCRIPTS, "protocol_compare.py"),
+                    "--nodes", "400", "--prob", "0.03", "--shares", "8",
+                    "--horizon", "32", "--json",
+                ],
+                "env": cpu,
+                "budget": args.stage_budget or 600,
+            },
             "kernel": {
                 "argv": kb_small,
                 "env": cpu,
@@ -164,6 +178,15 @@ def stage_specs(args) -> dict:
             # Bound the wait: the battery only starts a stage after a
             # healthy probe, so a long in-stage wait means a fresh wedge.
             "env": {"P2P_DEVICE_WAIT_S": "600"},
+            "budget": args.stage_budget or 1800,
+        },
+        "protocols": {
+            "argv": [
+                py, os.path.join(SCRIPTS, "protocol_compare.py"),
+                "--nodes", "100000", "--prob", "0.001", "--shares", "64",
+                "--horizon", "96", "--json",
+            ],
+            "env": sweep_env,
             "budget": args.stage_budget or 1800,
         },
         "kernel": {
