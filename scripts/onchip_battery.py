@@ -18,6 +18,9 @@ Stages (safest first; the known-crashy 1M run goes last by design):
   sweep1m   — kernel_bench.py --rows 1000000     one process per row count
                                                  so a crash is attributable)
   scale1m   — scale_1m.py --cache --block 8  -> the 1M north-star JSON line
+  scale1m_ba — scale_1m.py --topology ba     -> BASELINE config 4 (1M
+               scale-free) JSON line; very last — same crash surface as
+               scale1m with a skewed degree distribution on top
 
 Between stages a short health probe checks the tunnel still answers; a
 failed probe aborts the battery (later stages would only burn the wedge
@@ -52,7 +55,7 @@ ART_DIR = os.path.join(REPO, "docs", "artifacts")
 
 STAGE_ORDER = (
     "bench", "protocols", "kernel", "sweep250", "sweep500", "sweep1m",
-    "scale1m",
+    "scale1m", "scale1m_ba",
 )
 
 
@@ -159,6 +162,15 @@ def stage_specs(args) -> dict:
                 "env": cpu,
                 "budget": args.stage_budget or 900,
             },
+            "scale1m_ba": {
+                "argv": [
+                    py, os.path.join(SCRIPTS, "scale_1m.py"),
+                    "--topology", "ba", "--nodes", "2000", "--baM", "3",
+                    "--shares", "64", "--horizon", "48", "--block", "8",
+                ],
+                "env": cpu,
+                "budget": args.stage_budget or 900,
+            },
         }
     kb = [py, os.path.join(SCRIPTS, "kernel_bench.py")]
     # Bound every stage's device wait WELL inside its wall budget: the
@@ -213,6 +225,18 @@ def stage_specs(args) -> dict:
             "argv": [
                 py, os.path.join(SCRIPTS, "scale_1m.py"),
                 "--cache", args.cache, "--block", str(args.block),
+            ],
+            "env": sweep_env,
+            "budget": args.stage_budget or 3600,
+        },
+        "scale1m_ba": {
+            # BASELINE config 4: 1M-node scale-free. Mean degree ~2m is
+            # far below the ER north star's ~1000, but the hub rows give
+            # the degree-bucketed gather its worst-case skew.
+            "argv": [
+                py, os.path.join(SCRIPTS, "scale_1m.py"),
+                "--topology", "ba", "--baM", "3",
+                "--cache", args.ba_cache, "--block", str(args.block),
             ],
             "env": sweep_env,
             "budget": args.stage_budget or 3600,
@@ -279,8 +303,10 @@ def main() -> int:
     )
     ap.add_argument("--cache", default="/tmp/er1m.npz",
                     help="graph cache for the scale1m stage")
+    ap.add_argument("--ba-cache", default="/tmp/ba1m.npz",
+                    help="graph cache for the scale1m_ba stage")
     ap.add_argument("--block", type=int, default=8,
-                    help="degree block for the scale1m stage")
+                    help="degree block for the scale1m/scale1m_ba stages")
     ap.add_argument(
         "--no-probe", action="store_true",
         help="skip inter-stage health probes (smoke/CPU runs)",

@@ -57,7 +57,7 @@ def test_onchip_battery_smoke(tmp_path):
     tunnel-up window into artifacts, so its contract is tested harder
     than its numbers."""
     r = _run_script(
-        "onchip_battery.py", "--smoke", "--stages", "bench,scale1m",
+        "onchip_battery.py", "--smoke", "--stages", "bench,scale1m,scale1m_ba",
         "--art-dir", str(tmp_path), timeout=600,
     )
     assert r.returncode == 0, r.stderr[-2000:]
@@ -66,10 +66,13 @@ def test_onchip_battery_smoke(tmp_path):
     assert summary["stages"] == {
         "bench": {"ok": True, "rc": 0},
         "scale1m": {"ok": True, "rc": 0},
+        "scale1m_ba": {"ok": True, "rc": 0},
     }
     with open(summary["artifact"]) as f:
         records = [json.loads(line) for line in f]
-    assert [rec["stage"] for rec in records] == ["bench", "scale1m"]
+    assert [rec["stage"] for rec in records] == [
+        "bench", "scale1m", "scale1m_ba",
+    ]
     for rec in records:
         assert rec["ok"] and rec["results"], rec["stderr_tail"]
     # The bench stage's JSON line must be the bench.py contract.
