@@ -1,0 +1,153 @@
+"""Render an on-chip battery artifact (JSONL) as markdown tables.
+
+Closes the last gap between "the battery ran" and "the results are
+documented": `onchip_battery.py` persists one JSONL record per stage;
+this script turns that file into the markdown sections docs/RESULTS.md
+wants (headline bench row, protocol trade-off table, kernel A/B table,
+coverage-sweep bisection, 1M north-star lines), so a tunnel-up window
+minutes before a deadline still produces paste-ready documentation.
+
+Usage: python scripts/battery_report.py [docs/artifacts/battery_latest.jsonl]
+Markdown on stdout; exits 1 if the artifact records any failed stage so
+automation can tell a complete battery from a partial one.
+"""
+
+import json
+import os
+import sys
+
+DEFAULT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs", "artifacts", "battery_latest.jsonl",
+)
+
+
+def md_table(rows: list[dict], cols: list[str]) -> str:
+    out = ["| " + " | ".join(cols) + " |",
+           "|" + "---|" * len(cols)]
+    for r in rows:
+        # None (e.g. bench.py's deliberately-null pct_hbm_peak on CPU
+        # runs) renders as the same em-dash as a missing key.
+        out.append(
+            "| "
+            + " | ".join(
+                "—" if r.get(c) is None else str(r[c]) for c in cols
+            )
+            + " |"
+        )
+    return "\n".join(out)
+
+
+def main() -> int:
+    path = sys.argv[1] if len(sys.argv) > 1 else DEFAULT
+    truncated = 0
+    try:
+        with open(path) as f:
+            records = []
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    # A battery killed mid-append leaves a partial final
+                    # line; the completed stages must still render —
+                    # salvaging partial batteries is this script's job.
+                    truncated += 1
+    except FileNotFoundError:
+        print(f"error: no battery artifact at {path}", file=sys.stderr)
+        return 2
+    if not records:
+        print(f"error: {path} has no complete records", file=sys.stderr)
+        return 2
+    if truncated:
+        print(f"warning: skipped {truncated} truncated record(s) in {path}",
+              file=sys.stderr)
+
+    by_stage: dict[str, dict] = {}
+    for rec in records:
+        by_stage[rec["stage"]] = rec  # later run of a stage wins
+
+    print(f"# On-chip battery report — {records[0]['utc']}\n")
+    status_rows = [
+        {
+            "stage": r["stage"], "rc": r["rc"],
+            "wall_s": r["wall_s"], "results": len(r["results"]),
+        }
+        for r in records
+    ]
+    print(md_table(status_rows, ["stage", "rc", "wall_s", "results"]))
+    print()
+
+    bench = by_stage.get("bench")
+    if bench and bench["results"]:
+        row = bench["results"][-1]
+        print("## Headline bench\n")
+        print(md_table([row], [
+            "metric", "value", "unit", "vs_baseline", "achieved_gbps",
+            "pct_hbm_peak", "ticks",
+        ]))
+        print()
+
+    protocols = by_stage.get("protocols")
+    if protocols and protocols["results"]:
+        payload = protocols["results"][-1]
+        cfg = payload.get("config", {})
+        print(
+            f"## Protocol comparison (N={cfg.get('nodes')}, "
+            f"p={cfg.get('prob')}, {cfg.get('shares')} shares)\n"
+        )
+        print(md_table(payload.get("results", []), [
+            "protocol", "reached_fraction", "ttc_median_ticks",
+            "sends_per_delivery", "total_sent", "p95_latency_ticks",
+            "wall_s",
+        ]))
+        print()
+
+    kernel_rows = []
+    for stage in ("kernel", "sweep250", "sweep500", "sweep1m"):
+        rec = by_stage.get(stage)
+        if rec:
+            for row in rec["results"]:
+                kernel_rows.append({"stage": stage, **row})
+    if kernel_rows:
+        ab = [r for r in kernel_rows if "speedup" in r]
+        if ab:
+            print("## Kernel A/B (Pallas vs XLA; parity asserted "
+                  "before timing)\n")
+            print(md_table(ab, [
+                "stage", "kernel", "rows", "words", "xla_ms", "pallas_ms",
+                "speedup", "parity",
+            ]))
+            print()
+        gather = [r for r in kernel_rows if r.get("kernel") == "gather_or_xla"]
+        if gather:
+            print("## Gather-OR block sweep (XLA path)\n")
+            print(md_table(gather, [
+                "rows", "block", "ms_per_tick", "gathered_gb",
+                "achieved_gbps",
+            ]))
+            print()
+
+    for stage, title in (
+        ("scale1m", "1M north star (ER p=0.001)"),
+        ("scale1m_ba", "1M scale-free (BA m=3)"),
+    ):
+        rec = by_stage.get(stage)
+        if rec and rec["results"]:
+            print(f"## {title}\n")
+            print(md_table(rec["results"], [
+                "metric", "value", "unit", "vs_baseline",
+            ]))
+            print()
+
+    failed = [r["stage"] for r in records if not r.get("ok")]
+    if failed:
+        print(f"**Incomplete battery** — failed/aborted: {failed}. "
+              f"Stage stderr tails are in `{os.path.basename(path)}`.")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,3 +84,46 @@ def test_onchip_battery_rejects_unknown_stage():
     r = _run_script("onchip_battery.py", "--stages", "bench,nope")
     assert r.returncode == 2
     assert "unknown stages" in r.stderr
+
+
+def test_battery_report_renders_and_flags_failures(tmp_path):
+    """battery_report.py renders stage tables from a battery artifact and
+    exits nonzero when any stage failed (partial-battery detection)."""
+    art = tmp_path / "battery_x.jsonl"
+    ok_rec = {
+        "stage": "bench", "argv": [], "rc": 0, "ok": True, "wall_s": 1.0,
+        "results": [{"metric": "m", "value": 1, "unit": "u",
+                     "vs_baseline": 2, "achieved_gbps": 3,
+                     "pct_hbm_peak": None, "ticks": 4}],
+        "stdout_nonjson": [], "stderr_tail": "", "utc": "T",
+    }
+    art.write_text(json.dumps(ok_rec) + "\n")
+    r = _run_script("battery_report.py", str(art))
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "## Headline bench" in r.stdout and "| m | 1 | u | 2 |" in r.stdout
+
+    bad = dict(ok_rec, stage="scale1m", ok=False, rc="timeout", results=[])
+    art.write_text(json.dumps(ok_rec) + "\n" + json.dumps(bad) + "\n")
+    r2 = _run_script("battery_report.py", str(art))
+    assert r2.returncode == 1
+    assert "Incomplete battery" in r2.stdout and "scale1m" in r2.stdout
+
+
+def test_battery_report_salvages_truncated_artifact(tmp_path):
+    """A battery killed mid-append leaves a partial final line; completed
+    stages must still render (with a warning), and None values render as
+    an em-dash, not the string 'None'."""
+    art = tmp_path / "battery_t.jsonl"
+    rec = {
+        "stage": "bench", "argv": [], "rc": 0, "ok": True, "wall_s": 1.0,
+        "results": [{"metric": "m", "value": 1, "unit": "u",
+                     "vs_baseline": 2, "achieved_gbps": 3,
+                     "pct_hbm_peak": None, "ticks": 4}],
+        "stdout_nonjson": [], "stderr_tail": "", "utc": "T",
+    }
+    art.write_text(json.dumps(rec) + "\n" + '{"stage": "kern')
+    r = _run_script("battery_report.py", str(art))
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "## Headline bench" in r.stdout
+    assert "skipped 1 truncated record" in r.stderr
+    assert "None" not in r.stdout  # null pct_hbm_peak renders as em-dash
