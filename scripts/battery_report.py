@@ -16,9 +16,17 @@ import json
 import os
 import sys
 
+# Mirror onchip_battery.py's --art-dir resolution (P2P_BATTERY_DIR wins)
+# so a no-arg report reads the same battery_latest.jsonl the battery wrote.
 DEFAULT = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "docs", "artifacts", "battery_latest.jsonl",
+    os.environ.get(
+        "P2P_BATTERY_DIR",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "docs", "artifacts",
+        ),
+    ),
+    "battery_latest.jsonl",
 )
 
 
@@ -141,7 +149,9 @@ def main() -> int:
             ]))
             print()
 
-    failed = [r["stage"] for r in records if not r.get("ok")]
+    # Judge by each stage's LATEST record (matching the rendering above):
+    # a failed-then-rerun-succeeded stage is a success, not a partial.
+    failed = [s for s, r in by_stage.items() if not r.get("ok")]
     if failed:
         print(f"**Incomplete battery** — failed/aborted: {failed}. "
               f"Stage stderr tails are in `{os.path.basename(path)}`.")

@@ -127,3 +127,21 @@ def test_battery_report_salvages_truncated_artifact(tmp_path):
     assert "## Headline bench" in r.stdout
     assert "skipped 1 truncated record" in r.stderr
     assert "None" not in r.stdout  # null pct_hbm_peak renders as em-dash
+
+
+def test_battery_report_latest_stage_record_wins(tmp_path):
+    """A stage that failed and was re-run successfully counts as success:
+    exit code judges each stage's latest record, like the rendering."""
+    art = tmp_path / "battery_r.jsonl"
+    fail = {
+        "stage": "bench", "argv": [], "rc": "timeout", "ok": False,
+        "wall_s": 1.0, "results": [], "stdout_nonjson": [],
+        "stderr_tail": "first try", "utc": "T1",
+    }
+    ok = dict(fail, rc=0, ok=True, utc="T2", results=[
+        {"metric": "m", "value": 1, "unit": "u", "vs_baseline": 2},
+    ])
+    art.write_text(json.dumps(fail) + "\n" + json.dumps(ok) + "\n")
+    r = _run_script("battery_report.py", str(art))
+    assert r.returncode == 0, r.stdout + r.stderr[-300:]
+    assert "Incomplete battery" not in r.stdout

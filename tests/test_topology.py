@@ -271,3 +271,34 @@ def test_with_parallel_links_counters():
     assert "Peer count 3, Socket connections 2" in text
     # Unadjusted rows keep peer count == socket count.
     assert "Peer count 2, Socket connections 2" in text
+
+
+def test_parallel_link_extra_sparse_path_invariants():
+    """Above _DENSE_ER_LIMIT the builder switches to per-row binomial
+    sampling; the quirk vector must still satisfy the structural
+    invariants (the dense-path oracle can't run — different RNG): extras
+    come in adjacent (i-1, i) pairs that are real edges, and a doubled
+    pair requires row i to have no sampled upper edge."""
+    from p2p_gossip_tpu.models.topology import _DENSE_ER_LIMIT
+
+    n = _DENSE_ER_LIMIT + 500
+    p = 0.0006  # sparse enough that forced edges occur
+    total = 0
+    for seed in range(6):
+        g, extra = erdos_renyi(n, p, seed=seed, return_parallel_extra=True)
+        assert extra.shape == (n,) and (extra >= 0).all()
+        # Every doubled pair {i-1, i} marks both endpoints; walking the
+        # vector, unmatched residues must pair up with a neighbor.
+        resid = extra.copy()
+        for i in range(1, n):
+            m = min(resid[i - 1], resid[i])
+            if m:
+                # the pair must be an actual edge of the final graph
+                assert i in g.indices[g.indptr[i - 1]:g.indptr[i]].tolist() \
+                    or i - 1 in g.indices[g.indptr[i]:g.indptr[i + 1]].tolist()
+                resid[i - 1] -= m
+                resid[i] -= m
+                total += int(m)
+        assert (resid == 0).all(), f"seed {seed}: unpaired extras {resid}"
+    # With these parameters some seeds must exercise the quirk.
+    assert total > 0
