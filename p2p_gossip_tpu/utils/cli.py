@@ -495,6 +495,20 @@ def run(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.refParallelLinks and args.connectAtTick:
+        # In the reference, shares generated before makeconnections send
+        # zero copies (empty peer list) — but with_parallel_links charges
+        # (generated+forwarded)*extra for EVERY broadcast, so the combined
+        # flags would overcount Total sent by extra * (warm-up-generated
+        # shares on doubled nodes) and break check_conservation's fan math.
+        print(
+            "error: --refParallelLinks cannot be combined with "
+            "--connectAtTick (the quirk's reporting transform charges "
+            "extra sends for warm-up broadcasts that the reference never "
+            "sends)",
+            file=sys.stderr,
+        )
+        return 2
 
     use_native_builder = False
     if (

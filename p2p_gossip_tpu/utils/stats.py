@@ -78,7 +78,11 @@ class NodeStats:
     def __add__(self, other: "NodeStats") -> "NodeStats":
         """Chunk-wise accumulation (shares are independent, counters add).
         Summable ``extra`` entries are combined; array-valued ones are kept
-        only when a single operand carries them."""
+        only when a single operand carries them. Exception: ``peer_extra``
+        is a per-node property of the GRAPH (not a per-chunk counter) —
+        both operands must carry the same value (kept, not summed), and a
+        one-sided ``peer_extra`` is rejected loudly (it means summing
+        quirk-transformed stats with untransformed stats)."""
         assert np.array_equal(self.degree, other.degree), "stats from different graphs"
         out = NodeStats(
             generated=self.generated + other.generated,
@@ -91,11 +95,37 @@ class NodeStats:
         for key in set(self.extra) | set(other.extra):
             a, b = self.extra.get(key), other.extra.get(key)
             if a is not None and b is not None:
-                if np.isscalar(a) and np.isscalar(b):
+                if key == "peer_extra":
+                    # peer_extra is a per-node property of the GRAPH, not a
+                    # per-chunk counter: both operands passed through
+                    # with_parallel_links on the same topology, so the
+                    # arrays must match — keep one so the summed stats
+                    # still satisfy check_conservation's fan math
+                    # ((g1+f1)*fan + (g2+f2)*fan == (g+f)*fan). Silently
+                    # dropping it made a sum of two conserving chunks
+                    # fail conservation (round-3 advisor finding).
+                    # np.array_equal also covers the scalar representation
+                    # check_conservation supports (extra.get(..., 0)) —
+                    # scalar peer_extra must be KEPT too, never summed.
+                    assert np.array_equal(a, b), (
+                        "peer_extra differs between operands — stats from "
+                        "different quirk transforms cannot be summed"
+                    )
+                    out.extra[key] = a
+                elif np.isscalar(a) and np.isscalar(b):
                     out.extra[key] = a + b
-                # two array-valued entries (e.g. arrival_ticks for different
-                # share chunks) have no well-defined merge — drop them.
+                # other array-valued pairs (e.g. arrival_ticks for
+                # different share chunks) have no well-defined merge — drop.
             else:
+                # One-sided peer_extra means one operand was quirk-
+                # transformed and the other was not: the sum would pair an
+                # inflated Peer count with partially-uncharged sends. Fail
+                # here, where the cause is nameable, not later in
+                # check_conservation's generic fan assert.
+                assert key != "peer_extra", (
+                    "peer_extra present in only one operand — cannot sum "
+                    "quirk-transformed stats with untransformed stats"
+                )
                 out.extra[key] = a if a is not None else b
         return out
 

@@ -98,3 +98,66 @@ def test_message_redundancy_zero_delivery_is_json_safe():
     red = message_redundancy(stats)
     assert red["sends_per_delivery"] is None
     assert json.loads(json.dumps(red))["sends_per_delivery"] is None
+
+
+def test_nodestats_add_preserves_peer_extra():
+    """Summing two quirk-transformed chunks must keep peer_extra (it is a
+    graph property, identical in both) so the sum still passes
+    check_conservation — dropping it silently made a sum of two
+    conserving chunks fail conservation (round-3 advisor finding)."""
+    import pytest
+
+    from p2p_gossip_tpu.utils.stats import NodeStats
+
+    deg = np.array([2, 3, 2, 4], dtype=np.int64)
+    extra = np.array([1, 0, 0, 1], dtype=np.int64)
+
+    def chunk(gen):
+        gen = np.asarray(gen, dtype=np.int64)
+        fwd = gen * 2  # arbitrary but conserving: received == forwarded
+        s = NodeStats(
+            generated=gen, received=fwd, forwarded=fwd,
+            sent=(gen + fwd) * deg, processed=gen + fwd, degree=deg,
+        )
+        return s.with_parallel_links(extra)
+
+    a, b = chunk([1, 0, 2, 1]), chunk([0, 3, 1, 2])
+    a.check_conservation()
+    b.check_conservation()
+    total = a + b
+    assert np.array_equal(total.extra["peer_extra"], extra)
+    total.check_conservation()  # failed before the fix (fan fell to degree)
+
+    # Mismatched peer_extra = different graphs: loud failure, not silence.
+    c = chunk([1, 1, 1, 1])
+    c.extra["peer_extra"] = np.array([0, 1, 1, 0], dtype=np.int64)
+    with pytest.raises(AssertionError, match="peer_extra differs"):
+        a + c
+
+    # Transformed + untransformed is equally invalid — and must fail here,
+    # not later in check_conservation's generic fan assert.
+    d = chunk([1, 1, 1, 1])
+    del d.extra["peer_extra"]
+    d.sent = (d.generated + d.forwarded) * deg  # undo the inflation too
+    with pytest.raises(AssertionError, match="only one operand"):
+        a + d
+
+    # Scalar peer_extra (the uniform-extra form check_conservation also
+    # supports) must be KEPT, never summed — summing would double the
+    # graph property and fail conservation the same way dropping did.
+    def scalar_chunk(gen):
+        gen = np.asarray(gen, dtype=np.int64)
+        fwd = gen * 2
+        s = NodeStats(
+            generated=gen, received=fwd, forwarded=fwd,
+            sent=(gen + fwd) * (deg + 1), processed=gen + fwd, degree=deg,
+        )
+        s.extra["peer_extra"] = 1
+        return s
+
+    sa, sb = scalar_chunk([1, 0, 2, 1]), scalar_chunk([0, 3, 1, 2])
+    sa.check_conservation()
+    sb.check_conservation()
+    stotal = sa + sb
+    assert stotal.extra["peer_extra"] == 1
+    stotal.check_conservation()

@@ -486,3 +486,10 @@ def test_ref_parallel_links_flag():
         "--refParallelLinks", "--protocol", "pushpull", "--backend", "event",
     )
     assert bad2.returncode == 2 and "flood" in bad2.stderr
+    # --connectAtTick + quirk would overcount warm-up broadcasts that the
+    # reference never sends (round-3 advisor finding) — rejected cleanly.
+    bad3 = _run_cli(
+        "--numNodes", "10", "--connectionProb", "0.3", "--simTime", "2",
+        "--refParallelLinks", "--connectAtTick", "100", "--backend", "event",
+    )
+    assert bad3.returncode == 2 and "--connectAtTick" in bad3.stderr

@@ -129,6 +129,98 @@ def test_battery_report_salvages_truncated_artifact(tmp_path):
     assert "None" not in r.stdout  # null pct_hbm_peak renders as em-dash
 
 
+def test_tunnel_watch_oneshot_probe_failure_logged(tmp_path):
+    """A failed probe must leave an audit-log line and exit 1 — the
+    'trap was armed all round' evidence path. JAX_PLATFORMS=nope makes
+    the probe subprocess fail fast without a tunnel dependency."""
+    log = tmp_path / "watch.log"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "nope"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tunnel_watch.py"),
+         "--oneshot", "--probe-timeout", "60", "--log", str(log)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=180,
+    )
+    assert r.returncode == 1, r.stderr[-2000:]
+    recs = [json.loads(line) for line in log.read_text().splitlines()]
+    events = [rec["event"] for rec in recs]
+    assert events == ["watch_start", "probe"]
+    assert recs[1]["ok"] is False and recs[1]["err"]
+    # pid file is cleaned on every exit path (stale pid + kernel pid reuse
+    # would silently disarm future cron fires).
+    assert not (tmp_path / "watch.pid").exists()
+
+
+def test_tunnel_watch_oneshot_fires_battery_on_success(tmp_path):
+    """A healthy probe must fire the battery and log start/done records.
+    CPU probe succeeds locally; the battery runs in smoke mode with one
+    tiny stage so the test exercises the full fire path cheaply."""
+    log = tmp_path / "watch.log"
+    art = tmp_path / "art"
+    r = _run_script(
+        "tunnel_watch.py", "--oneshot", "--log", str(log),
+        "--battery-args",
+        f"--smoke --stages kernel --art-dir {art}",
+        timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    recs = [json.loads(line) for line in log.read_text().splitlines()]
+    events = [rec["event"] for rec in recs]
+    assert events == ["watch_start", "probe", "battery_start",
+                      "battery_done", "watch_done"]
+    assert recs[1]["ok"] is True
+    done = recs[3]
+    assert done["rc"] == 0, done
+    # The battery's own artifact landed where --art-dir pointed.
+    assert list(art.glob("battery_*.jsonl"))
+
+
+def test_tunnel_watch_second_instance_skips(tmp_path):
+    """Pid-file idempotency: while one watcher is alive, a second exits
+    immediately with a 'skip' audit line (cron may double-fire) — but a
+    pid recycled by an UNRELATED process must NOT disarm the watcher."""
+    log = tmp_path / "watch.log"
+    # A live process whose cmdline names tunnel_watch (the extra argv
+    # token stands in for the script path in a real watcher's cmdline).
+    holder = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)",
+         "tunnel_watch"],
+    )
+    try:
+        (tmp_path / "watch.pid").write_text(str(holder.pid))
+        r = _run_script("tunnel_watch.py", "--oneshot", "--log", str(log),
+                        timeout=120)
+        assert r.returncode == 0, r.stderr[-2000:]
+        recs = [json.loads(line) for line in log.read_text().splitlines()]
+        assert [rec["event"] for rec in recs] == ["skip"]
+    finally:
+        holder.kill()
+
+    # Same live pid, cmdline without 'tunnel_watch': treated as stale —
+    # the watcher proceeds (probe fails fast under a bogus backend).
+    log2 = tmp_path / "watch2.log"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "nope"
+    stale = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)"],
+    )
+    (tmp_path / "watch.pid").write_text(str(stale.pid))
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tunnel_watch.py"),
+         "--oneshot", "--probe-timeout", "60", "--log", str(log2)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=180,
+    )
+    try:
+        assert r2.returncode == 1, r2.stderr[-2000:]
+        events2 = [json.loads(line)["event"]
+                   for line in log2.read_text().splitlines()]
+        assert events2 == ["watch_start", "probe"]
+    finally:
+        stale.kill()
+
+
 def test_battery_report_latest_stage_record_wins(tmp_path):
     """A stage that failed and was re-run successfully counts as success:
     exit code judges each stage's latest record, like the rendering."""
