@@ -38,8 +38,14 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+#: Stamped into every emitted row once the device is known: CPU rows run
+#: Pallas in interpret mode, so their "speedup" numbers are meaningless
+#: for the TPU bake-off — they must never be mistaken for on-chip rows.
+_ROW_TAG: dict = {}
+
+
 def emit(**row):
-    print(json.dumps(row), flush=True)
+    print(json.dumps({**_ROW_TAG, **row}), flush=True)
 
 
 def chain_time(fn, x, iters=20):
@@ -75,9 +81,15 @@ def main():
         "--skip-gather", action="store_true",
         help="skip the gather timing (needs a 100K-node graph build)",
     )
-    args = ap.parse_args()
+    from p2p_gossip_tpu.utils.platform import (
+        add_cpu_arg,
+        apply_cpu_arg,
+        wait_for_device,
+    )
 
-    from p2p_gossip_tpu.utils.platform import wait_for_device
+    add_cpu_arg(ap)
+    args = ap.parse_args()
+    apply_cpu_arg(args)
 
     wait_for_device()
     import jax
@@ -87,6 +99,9 @@ def main():
     log(f"device: {dev}")
     on_tpu = dev.platform == "tpu"
     interpret = not on_tpu
+    _ROW_TAG["platform"] = dev.platform
+    if interpret:
+        _ROW_TAG["interpret_mode"] = True
 
     from p2p_gossip_tpu.engine.sync import apply_tick_updates
     from p2p_gossip_tpu.ops import bitmask

@@ -64,32 +64,12 @@ def log(msg: str) -> None:
 
 
 def stage_env(extra: dict | None = None) -> dict:
-    """Subprocess env with REPO entries filtered out of PYTHONPATH, plus
-    stage-specific overrides.
+    """Subprocess env for a stage: platform.tunnel_safe_env (repo entries
+    filtered from PYTHONPATH — the rationale lives there) plus
+    stage-specific overrides."""
+    from p2p_gossip_tpu.utils.platform import tunnel_safe_env
 
-    Two constraints pull in opposite directions: repo paths on PYTHONPATH
-    break the axon plugin's helper subprocess ("Backend 'axon' is not in
-    the list of known backends" — scripts/scale_1m.py header), but the
-    plugin itself registers FROM PYTHONPATH (this box exports
-    PYTHONPATH=/root/.axon_site), so stripping the variable wholesale
-    kills the TPU backend in every child. Filter, don't delete."""
-    env = dict(os.environ)
-    pp = env.get("PYTHONPATH")
-    if pp is not None:
-        kept = [
-            p for p in pp.split(os.pathsep)
-            if p and not (
-                os.path.abspath(p) == REPO
-                or os.path.abspath(p).startswith(REPO + os.sep)
-            )
-        ]
-        if kept:
-            env["PYTHONPATH"] = os.pathsep.join(kept)
-        else:
-            del env["PYTHONPATH"]
-    if extra:
-        env.update(extra)
-    return env
+    return tunnel_safe_env(extra)
 
 
 def tunnel_healthy(probe_timeout_s: float = 150.0) -> bool:
@@ -244,6 +224,39 @@ def stage_specs(args) -> dict:
     }
 
 
+def latest_records(art_dir: str) -> dict[str, dict]:
+    """Latest record per stage across every battery_*.jsonl artifact —
+    the same latest-record-wins rule battery_report.py judges by. Smoke
+    records prove the machinery, not the chip: they are ignored, or a
+    bare `--smoke` run into the default art dir would let the watcher's
+    next --skip-done fire skip every real stage on CPU evidence."""
+    import glob
+
+    latest: dict[str, dict] = {}
+    for path in glob.glob(os.path.join(art_dir, "battery_*.jsonl")):
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            name = rec.get("stage")
+            if not name or name.startswith("_") or rec.get("smoke"):
+                continue
+            if name not in latest or rec.get("utc", "") >= latest[name].get(
+                "utc", ""
+            ):
+                latest[name] = rec
+    return latest
+
+
 def run_stage(name: str, spec: dict) -> dict:
     """Run one stage to completion (or budget/crash) and return its
     record. stdout lines that parse as JSON are the stage's results."""
@@ -312,6 +325,13 @@ def main() -> int:
         help="skip inter-stage health probes (smoke/CPU runs)",
     )
     ap.add_argument(
+        "--skip-done", action="store_true",
+        help="skip stages whose latest artifact record is already ok — "
+        "a re-fire (the tunnel watcher's mode) then only runs what a "
+        "wedge skipped or failed, instead of burning the tunnel-up "
+        "window repeating succeeded heavy stages",
+    )
+    ap.add_argument(
         "--art-dir", default=os.environ.get("P2P_BATTERY_DIR", ART_DIR),
         help="artifact directory (default docs/artifacts; real on-chip "
         "runs commit theirs, tests point this at a tmp dir)",
@@ -352,7 +372,29 @@ def main() -> int:
             "utc": datetime.now(timezone.utc).isoformat(timespec="seconds"),
         }
 
-    summary = {"artifact": art_path, "stages": {}, "aborted": None}
+    summary = {"artifact": art_path, "stages": {}, "aborted": None,
+               "skipped_done": [], "smoke": bool(args.smoke)}
+    if args.skip_done:
+        prior = latest_records(args.art_dir)
+        done = {n for n, rec in prior.items() if rec.get("ok")}
+        summary["skipped_done"] = [s for s in stages if s in done]
+        for s in summary["skipped_done"]:
+            # Counts as ok for the exit code: its evidence already
+            # exists. Carry that evidence VERBATIM into this run's
+            # artifact — persist() copies the artifact over
+            # battery_latest.jsonl, so without the carry a re-fire that
+            # ran one stage would leave a "latest" file missing the
+            # other seven for battery_report.py.
+            summary["stages"][s] = {"ok": True, "rc": "skipped-done"}
+            persist(prior[s])
+        stages = [s for s in stages if s not in done]
+        if summary["skipped_done"]:
+            log(f"skip-done: {summary['skipped_done']} already ok in "
+                f"{args.art_dir}; running {stages or 'nothing'}")
+        if not stages:
+            print(json.dumps(summary))
+            return 0
+
     if probing and not tunnel_healthy():
         summary["aborted"] = "tunnel unhealthy before first stage"
         persist(abort_record(summary["aborted"]))
@@ -361,6 +403,10 @@ def main() -> int:
 
     for i, name in enumerate(stages):
         rec = run_stage(name, specs[name])
+        if args.smoke:
+            # Mark so done_stages never counts CPU smoke runs as on-chip
+            # evidence (and artifact readers can tell them apart).
+            rec["smoke"] = True
         persist(rec)
         summary["stages"][name] = {"ok": rec["ok"], "rc": rec["rc"]}
         remaining = stages[i + 1:]

@@ -39,9 +39,16 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--coverageFraction", type=float, default=0.99)
     ap.add_argument("--json", action="store_true")
-    args = ap.parse_args()
+    from p2p_gossip_tpu.utils.platform import (
+        add_cpu_arg,
+        apply_cpu_arg,
+        long_device_wait_s,
+        wait_for_device,
+    )
 
-    from p2p_gossip_tpu.utils.platform import long_device_wait_s, wait_for_device
+    add_cpu_arg(ap)
+    args = ap.parse_args()
+    apply_cpu_arg(args)
 
     # CPU: deregisters the tunnel plugin. TPU: waits out a wedged tunnel
     # with killable probes instead of hanging on first device query. No

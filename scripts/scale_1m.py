@@ -66,6 +66,9 @@ def main() -> None:
         "Barabasi-Albert scale-free topology (--baM edges per node)",
     )
     ap.add_argument("--baM", type=int, default=3)
+    from p2p_gossip_tpu.utils.platform import add_cpu_arg, apply_cpu_arg
+
+    add_cpu_arg(ap)
     ap.add_argument(
         "--mesh", type=str, default="",
         help="SxN (share-shards x node-shards): run the shard_map sharded "
@@ -73,6 +76,7 @@ def main() -> None:
         "the BASELINE v5e-8 configuration when 8 chips are attached",
     )
     args = ap.parse_args()
+    apply_cpu_arg(args)
 
     import jax
 
@@ -218,6 +222,11 @@ def main() -> None:
                 )
                 + (
                     f" ({args.mesh} mesh)" if args.mesh else " (single chip)"
+                )
+                + (
+                    ""
+                    if devices[0].platform == "tpu"
+                    else f" [{devices[0].platform}]"
                 ),
                 "value": round(wall, 2),
                 "unit": "s",

@@ -17,8 +17,13 @@ committed to the repo, runnable by cron/nohup with no builder attached:
     the battery's exit code;
   * a battery that exits nonzero (tunnel wedged mid-run, failed stage)
     puts the watcher back into probe mode after a cooldown, up to
-    --max-fires total battery attempts — the battery itself persists
-    per-stage records, so a re-fire only re-runs what a wedge skipped.
+    --max-fires total battery attempts — every fire passes --skip-done,
+    so a re-fire only runs stages whose latest artifact record is not
+    ok, never repeating succeeded heavy stages;
+  * a battery that exits 0 writes a `battery.done` latch next to the
+    audit log: later watcher starts (cron fires, fresh nohup loops)
+    exit immediately instead of re-running the whole battery every
+    probe interval. Delete the latch to force a fresh battery.
 
 Run it for a round (the driver's wall clock is ~12h):
 
@@ -45,6 +50,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPTS = os.path.join(REPO, "scripts")
+# For the lazy `from onchip_battery import STAGE_ORDER` (latch decision).
+sys.path.insert(0, SCRIPTS)
 DEFAULT_LOG = os.path.join(REPO, "docs", "artifacts", "watch.log")
 
 
@@ -55,28 +62,26 @@ def pid_path(log_path: str) -> str:
                         "watch.pid")
 
 
+def done_path(log_path: str) -> str:
+    """Completion latch next to the audit log: written only when a
+    battery exits 0 AND its summary covers every canonical stage (a
+    --stages subset must not block future fires for the stages it never
+    ran). Checked before every probe — without it the documented cron
+    --oneshot line would re-fire the full multi-hour battery every 20
+    minutes for the rest of the round. To force a fresh battery, delete
+    THIS file (deleting stage records alone does nothing: this check
+    runs before any probe)."""
+    return os.path.join(os.path.dirname(os.path.abspath(log_path)),
+                        "battery.done")
+
+
 def filtered_env() -> dict:
-    """Probe/battery subprocess env: repo entries filtered out of
-    PYTHONPATH (they break the axon plugin's helper subprocess) while
-    keeping non-repo entries (the plugin registers FROM
-    PYTHONPATH=/root/.axon_site on this box). Same filter as
-    onchip_battery.stage_env — duplicated here so the watcher runs even
-    if the battery script is mid-edit."""
-    env = dict(os.environ)
-    pp = env.get("PYTHONPATH")
-    if pp is not None:
-        kept = [
-            p for p in pp.split(os.pathsep)
-            if p and not (
-                os.path.abspath(p) == REPO
-                or os.path.abspath(p).startswith(REPO + os.sep)
-            )
-        ]
-        if kept:
-            env["PYTHONPATH"] = os.pathsep.join(kept)
-        else:
-            del env["PYTHONPATH"]
-    return env
+    """Probe/battery subprocess env — platform.tunnel_safe_env (repo
+    entries filtered from PYTHONPATH; the rationale lives there), shared
+    with the battery's stage_env so the rule cannot drift."""
+    from p2p_gossip_tpu.utils.platform import tunnel_safe_env
+
+    return tunnel_safe_env()
 
 
 def log_line(log_path: str, rec: dict) -> None:
@@ -98,11 +103,13 @@ def probe_once(timeout_s: float) -> tuple[bool, str]:
 
 
 def fire_battery(log_path: str, battery_budget_s: float,
-                 extra_args: list[str]) -> int:
+                 extra_args: list[str]) -> tuple[int, dict]:
     """Run the full battery as a subprocess; its own artifacts land in
-    docs/artifacts/battery_*.jsonl. Returns the battery's exit code
-    (or -1 on watcher-side timeout — the battery budgets its own stages,
-    so this outer budget only catches a hung battery process)."""
+    docs/artifacts/battery_*.jsonl. Returns (exit code, parsed summary
+    JSON or {}) — rc is -1 on watcher-side timeout (the battery budgets
+    its own stages, so this outer budget only catches a hung battery
+    process). The summary feeds the latch decision: a --stages subset
+    or a --smoke run must not latch completion."""
     argv = [sys.executable, os.path.join(SCRIPTS, "onchip_battery.py"),
             *extra_args]
     log_line(log_path, {"event": "battery_start", "argv": argv})
@@ -132,7 +139,14 @@ def fire_battery(log_path: str, battery_budget_s: float,
         "wall_s": round(time.monotonic() - t0, 1), "summary": tail[-2000:],
         "stderr_tail": err_tail[-2000:],
     })
-    return rc
+    summary: dict = {}
+    try:
+        parsed = json.loads(tail)
+        if isinstance(parsed, dict):
+            summary = parsed
+    except json.JSONDecodeError:
+        pass
+    return rc, summary
 
 
 def other_instance_alive(log_path: str) -> bool:
@@ -188,6 +202,11 @@ def main() -> int:
                     "space-separated (e.g. '--stages bench,kernel')")
     args = ap.parse_args()
 
+    if os.path.exists(done_path(args.log)):
+        log_line(args.log, {"event": "skip",
+                            "reason": "battery already complete "
+                            f"({done_path(args.log)} exists)"})
+        return 0
     if other_instance_alive(args.log):
         log_line(args.log, {"event": "skip", "reason": "instance alive"})
         return 0
@@ -205,6 +224,11 @@ def main() -> int:
 
 def watch_loop(args) -> int:
     extra = [a for a in args.battery_args.split() if a]
+    # Re-fires must not repeat succeeded heavy stages: the battery's
+    # latest-record-wins resume keeps the scarce tunnel-up window for
+    # what a wedge actually skipped or failed.
+    if "--skip-done" not in extra:
+        extra = ["--skip-done", *extra]
     deadline = (time.monotonic() + args.max_hours * 3600.0
                 if args.max_hours > 0 else None)
     fires = 0
@@ -219,10 +243,29 @@ def watch_loop(args) -> int:
                             "err": err if not ok else ""})
         if ok:
             fires += 1
-            rc = fire_battery(args.log, args.battery_budget, extra)
+            rc, summary = fire_battery(args.log, args.battery_budget, extra)
             if rc == 0:
+                from onchip_battery import STAGE_ORDER
+
+                covered = set(summary.get("stages", {}))
+                if summary.get("smoke"):
+                    # CPU smoke evidence must never disarm the trap.
+                    reason = "battery smoke ok; no completion latch"
+                elif covered >= set(STAGE_ORDER):
+                    # Latch completion so later watcher starts (cron
+                    # fires, fresh nohup loops) don't re-run the full
+                    # battery. Only for FULL coverage: latching a
+                    # --stages subset would permanently block the
+                    # stages it never ran.
+                    with open(done_path(args.log), "w") as f:
+                        f.write(datetime.now(timezone.utc).isoformat(
+                            timespec="seconds") + "\n")
+                    reason = "battery complete"
+                else:
+                    reason = (f"battery subset ok ({sorted(covered)}); "
+                              "no completion latch")
                 log_line(args.log, {"event": "watch_done",
-                                    "reason": "battery complete"})
+                                    "reason": reason})
                 return 0
             if args.oneshot or fires >= args.max_fires:
                 log_line(args.log, {"event": "watch_done",

@@ -35,6 +35,10 @@ def test_kernel_bench_smoke_emits_parseable_rows():
     for row in rows:
         if "parity" in row:
             assert row["parity"] == "ok"
+        # CPU rows run Pallas in interpret mode — they must be labeled so
+        # they are never mistaken for on-chip bake-off numbers.
+        assert row["platform"] == "cpu"
+        assert row["interpret_mode"] is True
 
 
 def test_protocol_compare_smoke_json():
@@ -48,6 +52,45 @@ def test_protocol_compare_smoke_json():
     assert {"flood", "pushpull", "pull", "pushk"} <= protos
     # Strict JSON round-trip (the sends_per_delivery None contract).
     json.loads(json.dumps(payload))
+
+
+def _run_script_cpu_flag(script, *args, timeout=420):
+    """Run a script relying on its --cpu flag INSTEAD of the env pin —
+    the no-chip exit a bare invocation on a chipless host needs."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", script), "--cpu", *args],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=timeout,
+    )
+
+
+def test_scale_1m_cpu_flag_runs_and_labels_metric():
+    """--cpu must skip the TPU wait entirely and stamp [cpu] into the JSON
+    metric so a host number is never mistaken for an on-chip result."""
+    r = _run_script_cpu_flag(
+        "scale_1m.py", "--nodes", "500", "--prob", "0.02", "--shares", "8",
+        "--horizon", "32", "--chunk", "0",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    row = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "[cpu]" in row["metric"]
+    assert row["unit"] == "s"
+    # The wait was SKIPPED, not won: the announce line prints before the
+    # first probe even on success, so its absence proves no wait started.
+    assert "waiting up to" not in r.stderr
+
+
+def test_protocol_compare_cpu_flag():
+    r = _run_script_cpu_flag(
+        "protocol_compare.py", "--json", "--nodes", "200", "--prob", "0.03",
+        "--shares", "4", "--horizon", "32",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads(r.stdout)
+    assert len(payload["results"]) == 4
+    assert "waiting up to" not in r.stderr
 
 
 def test_onchip_battery_smoke(tmp_path):
@@ -78,6 +121,67 @@ def test_onchip_battery_smoke(tmp_path):
     # The bench stage's JSON line must be the bench.py contract.
     bench_row = records[0]["results"][-1]
     assert {"metric", "value", "unit", "vs_baseline"} <= set(bench_row)
+
+
+def test_onchip_battery_skip_done(tmp_path):
+    """--skip-done (the watcher's re-fire mode) must skip stages whose
+    LATEST artifact record is ok and still run the rest — a tunnel-up
+    window is never spent repeating captured evidence, and a later
+    failed record outranks an earlier success (latest-record-wins,
+    battery_report's rule)."""
+    base = {
+        "argv": [], "rc": 0, "ok": True, "wall_s": 1.0,
+        "results": [{"metric": "m", "value": 1, "unit": "u",
+                     "vs_baseline": 2}],
+        "stdout_nonjson": [], "stderr_tail": "",
+    }
+    prior = dict(base, stage="bench", utc="2026-01-01T00:00:00+00:00")
+    k_ok = dict(base, stage="kernel", utc="2026-01-01T00:00:00+00:00")
+    k_bad = dict(base, stage="kernel", ok=False, rc=1,
+                 utc="2026-01-02T00:00:00+00:00", results=[])
+    (tmp_path / "battery_prior.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in (prior, k_ok, k_bad)) + "\n"
+    )
+    r = _run_script(
+        "onchip_battery.py", "--smoke", "--skip-done",
+        "--stages", "bench,kernel", "--art-dir", str(tmp_path), timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["skipped_done"] == ["bench"]
+    assert summary["stages"]["bench"] == {"ok": True, "rc": "skipped-done"}
+    assert summary["stages"]["kernel"] == {"ok": True, "rc": 0}
+    # The skipped stage's evidence is carried VERBATIM into this run's
+    # artifact: battery_latest.jsonl (a copy of it) must stay complete
+    # for battery_report.py even when a re-fire runs one stage.
+    with open(summary["artifact"]) as f:
+        arts = [json.loads(line) for line in f]
+    assert arts[0]["stage"] == "bench" and arts[0]["utc"] == prior["utc"]
+    assert [a["stage"] for a in arts] == ["bench", "kernel"]
+
+    # The kernel run above succeeded but in SMOKE mode: its record is
+    # marked and must NOT count as done — CPU smoke evidence skipping a
+    # real stage is exactly the bug done_stages guards against.
+    r2 = _run_script(
+        "onchip_battery.py", "--smoke", "--skip-done",
+        "--stages", "bench,kernel", "--art-dir", str(tmp_path), timeout=600,
+    )
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    s2 = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert s2["skipped_done"] == ["bench"]
+    assert s2["stages"]["kernel"] == {"ok": True, "rc": 0}
+
+    # A later REAL ok record does mark it done: a re-fire runs nothing.
+    k_fixed = dict(base, stage="kernel", utc="2026-01-03T00:00:00+00:00")
+    (tmp_path / "battery_fix.jsonl").write_text(json.dumps(k_fixed) + "\n")
+    r3 = _run_script(
+        "onchip_battery.py", "--smoke", "--skip-done",
+        "--stages", "bench,kernel", "--art-dir", str(tmp_path), timeout=120,
+    )
+    assert r3.returncode == 0, r3.stderr[-2000:]
+    s3 = json.loads(r3.stdout.strip().splitlines()[-1])
+    assert s3["skipped_done"] == ["bench", "kernel"]
+    assert s3["aborted"] is None
 
 
 def test_onchip_battery_rejects_unknown_stage():
@@ -174,6 +278,85 @@ def test_tunnel_watch_oneshot_fires_battery_on_success(tmp_path):
     assert done["rc"] == 0, done
     # The battery's own artifact landed where --art-dir pointed.
     assert list(art.glob("battery_*.jsonl"))
+    # A --stages SUBSET must not latch completion: latching here would
+    # permanently block the stages this fire never ran.
+    assert not (tmp_path / "battery.done").exists()
+
+
+def test_tunnel_watch_full_battery_latches(tmp_path):
+    """When a fire's summary covers every canonical stage (here via
+    --skip-done over seeded real ok records), the watcher must write the
+    completion latch so later starts don't re-fire the whole battery."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from onchip_battery import STAGE_ORDER
+
+    log = tmp_path / "watch.log"
+    art = tmp_path / "art"
+    art.mkdir()
+    base = {
+        "argv": [], "rc": 0, "ok": True, "wall_s": 1.0,
+        "results": [{"metric": "m", "value": 1, "unit": "u",
+                     "vs_baseline": 2}],
+        "stdout_nonjson": [], "stderr_tail": "",
+        "utc": "2026-01-01T00:00:00+00:00",
+    }
+    (art / "battery_seed.jsonl").write_text(
+        "\n".join(json.dumps(dict(base, stage=s)) for s in STAGE_ORDER)
+        + "\n"
+    )
+    r = _run_script(
+        "tunnel_watch.py", "--oneshot", "--log", str(log),
+        "--battery-args", f"--art-dir {art}", timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    recs = [json.loads(line) for line in log.read_text().splitlines()]
+    assert recs[-1]["event"] == "watch_done"
+    assert recs[-1]["reason"] == "battery complete"
+    assert (tmp_path / "battery.done").exists()
+
+
+def test_tunnel_watch_smoke_battery_never_latches(tmp_path):
+    """A --smoke battery run (CPU machinery check) must never write the
+    completion latch, even at full stage coverage — a latched smoke run
+    would disarm the trap for the rest of the round with zero on-chip
+    evidence captured."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from onchip_battery import STAGE_ORDER
+
+    log = tmp_path / "watch.log"
+    art = tmp_path / "art"
+    art.mkdir()
+    base = {
+        "argv": [], "rc": 0, "ok": True, "wall_s": 1.0, "results": [],
+        "stdout_nonjson": [], "stderr_tail": "",
+        "utc": "2026-01-01T00:00:00+00:00",
+    }
+    (art / "battery_seed.jsonl").write_text(
+        "\n".join(json.dumps(dict(base, stage=s)) for s in STAGE_ORDER)
+        + "\n"
+    )
+    r = _run_script(
+        "tunnel_watch.py", "--oneshot", "--log", str(log),
+        "--battery-args", f"--smoke --art-dir {art}", timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    recs = [json.loads(line) for line in log.read_text().splitlines()]
+    assert recs[-1]["reason"] == "battery smoke ok; no completion latch"
+    assert not (tmp_path / "battery.done").exists()
+
+
+def test_tunnel_watch_done_latch_skips(tmp_path):
+    """After a complete battery, the done latch must stop later watcher
+    starts (cron fires every 20 min) from re-firing the full multi-hour
+    battery while the tunnel is healthy."""
+    log = tmp_path / "watch.log"
+    (tmp_path / "battery.done").write_text("2026-01-01T00:00:00+00:00\n")
+    r = _run_script("tunnel_watch.py", "--oneshot", "--log", str(log),
+                    timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    recs = [json.loads(line) for line in log.read_text().splitlines()]
+    assert [rec["event"] for rec in recs] == ["skip"]
+    assert "battery already complete" in recs[0]["reason"]
 
 
 def test_tunnel_watch_second_instance_skips(tmp_path):
