@@ -235,7 +235,6 @@ def assemble_snapshots(schedule, churn, boundaries, snap_received, connections):
 
 def apply_tick_updates(
     seen, arrivals, gen_bits, gen_cnt, received, sent, degree,
-    use_pallas: bool = False,
 ):
     """The shared counter semantics of one tick (reference: p2pnode.cc
     ReceiveShare/GenerateAndGossipShare): dedup against ``seen``, count
@@ -245,20 +244,13 @@ def apply_tick_updates(
     both the single-device and the sharded engines — the bitwise-parity
     contract between them lives here.
 
-    ``use_pallas`` routes the bitmask stage through the fused one-pass
-    kernel (`ops.pallas_kernels.tick_update_pallas`, bitwise-identical);
-    the (N,)-sized counter arithmetic stays in jnp either way."""
-    if use_pallas:
-        from p2p_gossip_tpu.ops.pallas_kernels import tick_update_pallas
-
-        seen, newly_out, newly_cnt = tick_update_pallas(
-            arrivals, seen, gen_bits
-        )
-    else:
-        newly = arrivals & ~seen
-        newly_cnt = bitmask.popcount_rows(newly)
-        seen = seen | arrivals | gen_bits
-        newly_out = newly | gen_bits
+    Deliberately plain jnp: a fused Pallas formulation of this stage lost
+    0.50x to the XLA graph on hardware (round-4 bake-off, docs/RESULTS.md)
+    — XLA already fuses this chain optimally."""
+    newly = arrivals & ~seen
+    newly_cnt = bitmask.popcount_rows(newly)
+    seen = seen | arrivals | gen_bits
+    newly_out = newly | gen_bits
     received = received + newly_cnt
     sent = sent + (newly_cnt + gen_cnt) * degree
     return seen, newly_out, received, sent
@@ -266,14 +258,11 @@ def apply_tick_updates(
 
 def _tick_body(
     dg: DeviceGraph, block: int, state, origins, slots, gen_ticks, churn=None,
-    loss=None, use_pallas_tick: bool = False, connect_tick: int = 0,
-    cov_slots: int | None = None,
+    loss=None, connect_tick: int = 0,
 ):
-    """One synchronous tick. state = (t, seen, hist, received, sent).
-    Returns ``(state', cov_delta)`` — cov_delta is the per-slot coverage
-    gained this tick when the fused coverage kernel ran (``cov_slots``
-    set AND ``use_pallas_tick``), else None and the caller derives it
-    from the hist slot just written.
+    """One synchronous tick. state = (t, seen, hist, received, sent) ->
+    state'. Coverage-recording callers derive the tick's coverage delta
+    from the hist slot this tick writes (it IS the newly_out frontier).
 
     ``churn`` is an optional ``(down_start, down_end)`` pair of (N, K)
     interval arrays (models/churn.py): a down node's arrivals are lost
@@ -284,9 +273,6 @@ def _tick_body(
     erasure model (models/linkloss.py), applied edge-wise inside the
     gather before the OR-reduce.
     """
-    assert not (connect_tick and cov_slots is not None), (
-        "coverage runs never model the warm-up window"
-    )
     t, seen, hist, received, sent = state
     n, w = seen.shape
     if dg.buckets is not None:
@@ -317,7 +303,6 @@ def _tick_body(
         .at[origins]
         .add(gen_active.astype(jnp.int32))
     )
-    cov_delta = None
     if connect_tick:
         # Socket warm-up window (p2pnetwork.cc:93-96): a whole tick is
         # either pre- or post-connect. Pre-connect generations enter the
@@ -329,35 +314,19 @@ def _tick_body(
         live_cnt = jnp.where(pre, 0, gen_cnt)
         seen, newly_out, received, sent = apply_tick_updates(
             seen, arrivals, live_bits, live_cnt, received, sent, dg.degree,
-            use_pallas=use_pallas_tick,
         )
         seen = seen | jnp.where(pre, gen_bits, jnp.uint32(0))
-    elif cov_slots is not None and use_pallas_tick:
-        # Coverage-recording fast path: the fused kernel emits the tick's
-        # coverage delta from the tile already in VMEM — zero extra HBM
-        # passes for per-tick coverage (the 1M north-star metric).
-        from p2p_gossip_tpu.ops.pallas_kernels import tick_update_cov_pallas
-
-        seen, newly_out, newly_cnt, cov_delta = tick_update_cov_pallas(
-            arrivals, seen, gen_bits, cov_slots
-        )
-        received = received + newly_cnt
-        sent = sent + (newly_cnt + gen_cnt) * dg.degree
     else:
         seen, newly_out, received, sent = apply_tick_updates(
             seen, arrivals, gen_bits, gen_cnt, received, sent, dg.degree,
-            use_pallas=use_pallas_tick,
         )
     hist = hist.at[jnp.mod(t, dg.ring_size)].set(newly_out)
-    return (t + 1, seen, hist, received, sent), cov_delta
+    return (t + 1, seen, hist, received, sent)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=(
-        "chunk_size", "horizon", "block", "loss", "use_pallas_tick",
-        "connect_tick",
-    ),
+    static_argnames=("chunk_size", "horizon", "block", "loss", "connect_tick"),
 )
 def _run_chunk_while(
     dg: DeviceGraph,
@@ -372,7 +341,6 @@ def _run_chunk_while(
     horizon: int,
     block: int,
     loss: tuple | None = None,
-    use_pallas_tick: bool = False,
     connect_tick: int = 0,
 ):
     """Run one share chunk to quiescence (or the horizon) under while_loop.
@@ -406,9 +374,9 @@ def _run_chunk_while(
             snaps = jnp.where(
                 (snap_ticks == t)[:, None], received[None, :], snaps
             )
-        (t, seen, hist, received, sent), _ = _tick_body(
+        t, seen, hist, received, sent = _tick_body(
             dg, block, (t, seen, hist, received, sent), origins, slots,
-            gen_ticks, churn, loss, use_pallas_tick, connect_tick,
+            gen_ticks, churn, loss, connect_tick,
         )
         return (t, seen, hist, received, sent, snaps)
 
@@ -426,7 +394,7 @@ def _run_chunk_while(
     jax.jit,
     static_argnames=(
         "chunk_size", "horizon", "block", "use_pallas", "coverage_slots",
-        "loss", "use_pallas_tick",
+        "loss",
     ),
 )
 def _run_chunk_coverage(
@@ -441,7 +409,6 @@ def _run_chunk_coverage(
     use_pallas: bool = False,
     coverage_slots: int | None = None,
     loss: tuple | None = None,
-    use_pallas_tick: bool = False,
 ):
     """Coverage-recording run from t=0 — drives the time-to-coverage
     metrics. Returns per-tick coverage (horizon, S) but exits the tick loop
@@ -453,8 +420,7 @@ def _run_chunk_coverage(
     the ``newly_out`` frontier at most once (dedup makes ticks disjoint),
     so per-tick coverage is a running sum of the frontier's per-slot
     counts — reading the just-written (N, cov_w) hist slot instead of
-    re-reducing the full seen bitmask, and falling out of the fused tick
-    kernel entirely (zero extra HBM passes) when ``use_pallas_tick``.
+    re-reducing the full seen bitmask.
     ``use_pallas`` selects the one-pass coverage kernel for the delta
     reduction on TPU. ``coverage_slots`` limits the recorded coverage to
     the first S slots (the live shares) — the chunk itself may be
@@ -489,19 +455,13 @@ def _run_chunk_coverage(
 
     def step(full_state):
         t, seen, hist, received, sent, cov_run, cov_hist = full_state
-        # The fused tick+coverage kernel embeds the same revisited
-        # coverage accumulator the coverage-kernel row bound quarantines
-        # (the unresolved-1M-crash suspect) — require BOTH gates.
-        fused_cov = use_pallas_tick and use_pallas
-        new_state, cov_delta = _tick_body(
+        new_state = _tick_body(
             dg, block, (t, seen, hist, received, sent), origins, slots,
-            gen_ticks, churn, loss, use_pallas_tick,
-            cov_slots=cov_slots if fused_cov else None,
+            gen_ticks, churn, loss,
         )
-        if cov_delta is None:
-            # hist slot (t mod D) was written by this tick: it IS the
-            # newly_out frontier.
-            cov_delta = cov_delta_of(new_state[2][jnp.mod(t, dg.ring_size)])
+        # hist slot (t mod D) was written by this tick: it IS the
+        # newly_out frontier.
+        cov_delta = cov_delta_of(new_state[2][jnp.mod(t, dg.ring_size)])
         cov_run = cov_run + cov_delta
         cov_hist = jax.lax.dynamic_update_slice(
             cov_hist, cov_run[None], (t, 0)
@@ -572,13 +532,6 @@ def run_sync_sim(
     chunk_size = min(chunk_size, max(MIN_CHUNK_SHARES, schedule.num_shares))
     # Round chunk size up to whole words.
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
-    # Fused tick-update kernel: TPU-only, inside its hardware-validated
-    # row bound (ops/pallas_kernels.py PALLAS_TICK_MAX_ROWS).
-    from p2p_gossip_tpu.ops.pallas_kernels import tick_rows_ok
-
-    on_tpu = any(d.platform == "tpu" for d in dg.ell_idx.devices())
-    use_pallas_tick = on_tpu and tick_rows_ok(graph.n)
-
     boundaries = filter_snapshot_boundaries(snapshot_ticks, horizon_ticks)
     snap_ticks_dev = (
         jnp.asarray(boundaries, dtype=jnp.int32) if boundaries else None
@@ -644,8 +597,7 @@ def run_sync_sim(
                 dg, jnp.asarray(origins), jnp.asarray(gen_ticks), t_start,
                 last_gen, churn_dev, snap_ticks_dev,
                 chunk_size=chunk_size, horizon=horizon_ticks, block=block,
-                loss=loss_cfg, use_pallas_tick=use_pallas_tick,
-                connect_tick=connect_tick,
+                loss=loss_cfg, connect_tick=connect_tick,
             )
             received += np.asarray(r, dtype=np.int64)
             sent += np.asarray(s, dtype=np.int64)
@@ -702,23 +654,21 @@ def run_flood_coverage(
     # Gate on where the graph actually lives (tests pin data to host CPU
     # even though a TPU plugin is registered) and on the kernel's validated
     # row bound (ops/pallas_kernels.py PALLAS_COVERAGE_MAX_ROWS).
-    from p2p_gossip_tpu.ops.pallas_kernels import coverage_rows_ok, tick_rows_ok
+    from p2p_gossip_tpu.ops.pallas_kernels import coverage_rows_ok
 
     on_tpu = any(d.platform == "tpu" for d in dg.ell_idx.devices())
     use_pallas = on_tpu and coverage_rows_ok(dg.n)
     if on_tpu and not use_pallas:
         log.info(
-            f"coverage: Pallas kernel demoted to the XLA path (N={dg.n} "
-            "exceeds PALLAS_COVERAGE_MAX_ROWS)"
+            f"coverage: Pallas kernel on the XLA path (N={dg.n} exceeds "
+            "PALLAS_COVERAGE_MAX_ROWS, the measured 100K crossover)"
         )
-    use_pallas_tick = on_tpu and tick_rows_ok(dg.n)
     churn_dev = churn_to_device(churn)
     loss_cfg = loss.static_cfg if loss is not None else None
     _, r, snt, cov = _run_chunk_coverage(
         dg, jnp.asarray(o), jnp.asarray(g), churn_dev,
         chunk_size=chunk_size, horizon=horizon_ticks, block=block,
         use_pallas=use_pallas, coverage_slots=s, loss=loss_cfg,
-        use_pallas_tick=use_pallas_tick,
     )
     generated = effective_generated(sched, horizon_ticks, churn)
     received = np.asarray(r, dtype=np.int64)

@@ -406,3 +406,66 @@ def load_graph_cache(path: str) -> tuple[Graph, str | None]:
             f"({type(e).__name__}: {e}); delete it to rebuild"
         ) from e
     return graph, fp
+
+
+def scale_graph_fingerprint(
+    topology: str, nodes: int, prob: float, ba_m: int, seed: int
+) -> str:
+    """Build-parameter fingerprint for the big-graph caches shared by
+    scripts/scale_1m.py and scripts/mesh_rehearsal.py — one definition so
+    the two scripts can never desync. ``ba_m`` is pinned for non-BA
+    topologies (it does not affect an ER build, and pinning keeps an ER
+    cache valid across --baM values); the pinned value and the
+    "scale_1m" prefix match the fingerprints of caches built by earlier
+    revisions, which stay loadable."""
+    from p2p_gossip_tpu.utils.checkpoint import fingerprint
+
+    return fingerprint(
+        "scale_1m", topology, nodes, prob,
+        ba_m if topology == "ba" else 3, seed,
+    )
+
+
+def load_or_build_graph_cache(
+    cache: str,
+    *,
+    topology: str,
+    nodes: int,
+    prob: float,
+    ba_m: int,
+    seed: int,
+    build,
+    log,
+) -> Graph:
+    """The load-validate-build-save protocol for the big-graph caches:
+    load ``cache`` if it exists and its fingerprint matches the build
+    parameters (a legacy cache with no fingerprint loads with a warning),
+    else call ``build()`` and save the result under the shared
+    fingerprint. ``cache`` may be empty (always build, never save).
+    Raises SystemExit(2) with a clean message on an unreadable cache or
+    a fingerprint mismatch — delete the file or match the original
+    arguments."""
+    import os
+    import time
+
+    fp = scale_graph_fingerprint(topology, nodes, prob, ba_m, seed)
+    if cache and os.path.exists(cache):
+        t0 = time.perf_counter()
+        try:
+            graph, cached_fp = load_graph_cache(cache)
+        except ValueError as e:
+            log(f"error: --cache {e}")
+            raise SystemExit(2)
+        if not cached_fp:  # None (no fp key) or "" (saved without one)
+            log(f"WARNING: {cache} predates cache fingerprints — "
+                "assuming it matches the requested topology flags")
+        elif cached_fp != fp:
+            log(f"error: {cache} was built with different topology "
+                "flags; delete it or match the original arguments")
+            raise SystemExit(2)
+        log(f"graph loaded from {cache}: {time.perf_counter()-t0:.1f}s")
+        return graph
+    graph = build()
+    if cache:
+        save_graph_cache(cache, graph, fp=fp)
+    return graph

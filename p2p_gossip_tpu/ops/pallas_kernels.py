@@ -20,6 +20,13 @@ Kernels fall back to the jnp reference implementation off-TPU; tests compare
 against it in interpret mode. Measured on v5e (100K x 128 words, 50 chained
 ops): naive jnp expansion 14.5 ms/op, per-bit-loop kernel 19.2 ms/op
 (sublane-hostile accumulator), this vectorized kernel 13.5 ms/op.
+
+A fused tick-update kernel (and a +coverage variant) lived here through
+round 3 with interpret-mode parity; the round-4 on-chip bake-off measured
+it at 0.50x/0.60x of the fused XLA graph (docs/RESULTS.md "Kernel
+bake-off") — XLA fuses the arrivals->newly->seen->popcount chain better
+than the hand tiling — so it was deleted rather than left as a
+permanently-gated code path.
 """
 
 from __future__ import annotations
@@ -36,20 +43,12 @@ from p2p_gossip_tpu.ops.bitmask import WORD_BITS, num_words
 DEFAULT_ROW_TILE = 256
 
 # Row bound for using the coverage kernel on real TPU (override with the
-# P2P_PALLAS_COVERAGE_MAX_ROWS env var; 0 disables the kernel). The kernel
-# is validated on-chip to 100K rows; a TPU worker crash observed once at
-# 1M rows is unresolved — the suspect list includes this kernel's ~3900-step
-# revisited-output grid — so anything beyond the validated size defaults to
-# the XLA path until the kernel is exonerated on hardware.
+# P2P_PALLAS_COVERAGE_MAX_ROWS env var; 0 disables the kernel). This is a
+# MEASURED crossover, not a caution bound: the round-4 on-chip bake-off
+# (docs/RESULTS.md, battery stages kernel/sweep250) has the kernel winning
+# 1.61x at 100K rows and losing 0.28x at 250K — XLA's per-bit reduction
+# amortizes better as the row grid grows past ~400 revisited-output steps.
 PALLAS_COVERAGE_MAX_ROWS = 100_000
-
-
-# Row bound for the fused tick-update kernel on real TPU (env override
-# P2P_PALLAS_TICK_MAX_ROWS; 0 disables). Starts at 0 — the kernel is
-# parity-tested in interpret mode but not yet validated on hardware; the
-# kernel bake-off (scripts/kernel_bench.py) validates and this constant
-# records the validated size.
-PALLAS_TICK_MAX_ROWS = 0
 
 
 def _rows_ok(n_rows: int, env_name: str, default_limit: int) -> bool:
@@ -78,12 +77,6 @@ def coverage_rows_ok(n_rows: int) -> bool:
     )
 
 
-def tick_rows_ok(n_rows: int) -> bool:
-    """Whether the fused tick-update kernel should be used for ``n_rows``
-    (see PALLAS_TICK_MAX_ROWS)."""
-    return _rows_ok(n_rows, "P2P_PALLAS_TICK_MAX_ROWS", PALLAS_TICK_MAX_ROWS)
-
-
 def _bit_column_counts(tile: jnp.ndarray) -> jnp.ndarray:
     """(TILE_N, W) uint32 -> (32, W) int32 per-bit column counts. The bit
     expansion is one broadcast shift over the VMEM-resident tile (measured
@@ -95,18 +88,6 @@ def _bit_column_counts(tile: jnp.ndarray) -> jnp.ndarray:
         (tile[:, None, :] >> shifts[None, :, :]) & jnp.uint32(1)
     ).astype(jnp.int32)
     return jnp.sum(bits, axis=0)
-
-
-def _tick_update_compute(arr, sn, gb):
-    """The fused tick update on one VMEM tile: returns
-    (seen', newly_out, newly_cnt). Shared by the tick kernels so the
-    semantics can't diverge between the plain and +coverage variants."""
-    newly = arr & ~sn
-    cnt = jnp.sum(
-        jax.lax.population_count(newly).astype(jnp.int32),
-        axis=1, keepdims=True,
-    )
-    return sn | arr | gb, newly | gb, cnt
 
 
 def _coverage_kernel(seen_ref, acc_ref):
@@ -150,154 +131,6 @@ def coverage_per_slot_pallas(
     )(seen)
     # acc[b, w] = count of slot w*32+b -> transpose to slot-major.
     return acc.T.reshape(w * WORD_BITS)[:n_slots]
-
-
-def _tick_update_kernel(
-    arrivals_ref, seen_ref, gen_ref, seen_out_ref, newly_out_ref, cnt_ref
-):
-    """The fused tick update (engine.sync.apply_tick_updates' bitmask
-    stage) on one VMEM-resident row tile:
-
-        newly     = arrivals & ~seen
-        seen'     = seen | arrivals | gen_bits
-        newly_out = newly | gen_bits        (next delay-line slot)
-        cnt       = popcount_rows(newly)    (first-time receives)
-
-    One HBM pass — 3 tile reads, 2 tile writes + an (N, 1) count — where
-    the unfused XLA graph materializes `newly`, `seen'`, and `newly_out`
-    as separate kernels re-reading their inputs (~8 reads / 3 writes).
-    """
-    seen_out_ref[:], newly_out_ref[:], cnt_ref[:] = _tick_update_compute(
-        arrivals_ref[:], seen_ref[:], gen_ref[:]
-    )
-
-
-@functools.partial(jax.jit, static_argnames=("row_tile", "interpret"))
-def tick_update_pallas(
-    arrivals: jnp.ndarray,  # (N, W) uint32
-    seen: jnp.ndarray,      # (N, W) uint32
-    gen_bits: jnp.ndarray,  # (N, W) uint32
-    row_tile: int = DEFAULT_ROW_TILE,
-    interpret: bool = False,
-):
-    """Fused bitmask tick update: returns (seen', newly_out, newly_cnt).
-
-    Bitwise-identical to the jnp formulation in
-    `engine.sync.apply_tick_updates` (the parity tests assert exactly
-    this); the counter arithmetic (received/sent) stays outside — it is
-    (N,)-sized and free."""
-    n, w = seen.shape
-    pad = (-n) % row_tile
-    if pad:
-        arrivals = jnp.pad(arrivals, ((0, pad), (0, 0)))
-        seen = jnp.pad(seen, ((0, pad), (0, 0)))
-        gen_bits = jnp.pad(gen_bits, ((0, pad), (0, 0)))
-    n_padded = seen.shape[0]
-    grid = (n_padded // row_tile,)
-    tile = lambda: pl.BlockSpec(  # noqa: E731
-        (row_tile, w), lambda i: (i, 0), memory_space=pltpu.VMEM
-    )
-    seen_out, newly_out, cnt = pl.pallas_call(
-        _tick_update_kernel,
-        grid=grid,
-        in_specs=[tile(), tile(), tile()],
-        out_specs=(
-            tile(),
-            tile(),
-            pl.BlockSpec((row_tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((n_padded, w), jnp.uint32),
-            jax.ShapeDtypeStruct((n_padded, w), jnp.uint32),
-            jax.ShapeDtypeStruct((n_padded, 1), jnp.int32),
-        ),
-        interpret=interpret,
-    )(arrivals, seen, gen_bits)
-    return seen_out[:n], newly_out[:n], cnt[:n, 0]
-
-
-def _make_tick_update_cov_kernel(cov_w: int):
-    """Tick update fused with the per-slot coverage DELTA of the tick.
-
-    Coverage is a cumulative sum over ticks of the newly-acquired
-    frontier's per-slot bit-column counts (each (node, share) bit enters
-    ``newly_out`` at most once — dedup guarantees disjointness across
-    ticks), so the delta falls out of the tile already in VMEM: the
-    coverage-recording tick costs ZERO extra HBM passes over the
-    separate-coverage formulation's full (N, W) re-read per tick. The
-    (32, cov_w) accumulator is a revisited output across the row grid,
-    like `_coverage_kernel`."""
-
-    def kernel(arr_ref, seen_ref, gen_ref,
-               seen_out_ref, newly_out_ref, cnt_ref, cov_ref):
-        i = pl.program_id(0)
-
-        @pl.when(i == 0)
-        def _():
-            cov_ref[:] = jnp.zeros_like(cov_ref)
-
-        seen_out, nout, cnt = _tick_update_compute(
-            arr_ref[:], seen_ref[:], gen_ref[:]
-        )
-        seen_out_ref[:] = seen_out
-        newly_out_ref[:] = nout
-        cnt_ref[:] = cnt
-        cov_ref[:] += _bit_column_counts(nout[:, :cov_w])
-
-    return kernel
-
-
-@functools.partial(
-    jax.jit, static_argnames=("cov_slots", "row_tile", "interpret")
-)
-def tick_update_cov_pallas(
-    arrivals: jnp.ndarray,  # (N, W) uint32
-    seen: jnp.ndarray,      # (N, W) uint32
-    gen_bits: jnp.ndarray,  # (N, W) uint32
-    cov_slots: int,
-    row_tile: int = DEFAULT_ROW_TILE,
-    interpret: bool = False,
-):
-    """Fused tick update + coverage delta: returns
-    (seen', newly_out, newly_cnt, cov_delta) with cov_delta (cov_slots,)
-    int32 — the number of nodes acquiring each of the first ``cov_slots``
-    shares THIS tick. Bitwise-identical to `tick_update_pallas` plus
-    `bitmask.coverage_per_slot(newly_out[:, :cov_w], cov_slots)`."""
-    n, w = seen.shape
-    cov_w = num_words(cov_slots)
-    assert cov_w <= w
-    pad = (-n) % row_tile
-    if pad:
-        arrivals = jnp.pad(arrivals, ((0, pad), (0, 0)))
-        seen = jnp.pad(seen, ((0, pad), (0, 0)))
-        gen_bits = jnp.pad(gen_bits, ((0, pad), (0, 0)))
-    n_padded = seen.shape[0]
-    grid = (n_padded // row_tile,)
-    tile = lambda: pl.BlockSpec(  # noqa: E731
-        (row_tile, w), lambda i: (i, 0), memory_space=pltpu.VMEM
-    )
-    seen_out, newly_out, cnt, acc = pl.pallas_call(
-        _make_tick_update_cov_kernel(cov_w),
-        grid=grid,
-        in_specs=[tile(), tile(), tile()],
-        out_specs=(
-            tile(),
-            tile(),
-            pl.BlockSpec((row_tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec(
-                (WORD_BITS, cov_w), lambda i: (0, 0), memory_space=pltpu.VMEM
-            ),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((n_padded, w), jnp.uint32),
-            jax.ShapeDtypeStruct((n_padded, w), jnp.uint32),
-            jax.ShapeDtypeStruct((n_padded, 1), jnp.int32),
-            jax.ShapeDtypeStruct((WORD_BITS, cov_w), jnp.int32),
-        ),
-        interpret=interpret,
-    )(arrivals, seen, gen_bits)
-    cov_delta = acc.T.reshape(cov_w * WORD_BITS)[:cov_slots]
-    return seen_out[:n], newly_out[:n], cnt[:n, 0], cov_delta
 
 
 def _popcount_rows_kernel(words_ref, out_ref):

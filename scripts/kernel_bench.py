@@ -5,10 +5,11 @@ formulation at bench-relevant shapes, asserting bitwise parity before
 timing:
 
 1. coverage_per_slot   — Pallas one-pass kernel vs the jnp bit-expansion
-                         (row sweep doubles as the 1M-crash bisection)
-2. tick update         — fused tick_update_pallas vs the unfused
-                         apply_tick_updates jnp stage
-3. gather-OR frontier  — the XLA blocked-gather path at several degree
+                         (row sweep doubles as the 1M-crash bisection;
+                         the fused tick-update kernels that used to be
+                         benched between 1 and 2 lost on hardware and
+                         were deleted — see docs/RESULTS.md)
+2. gather-OR frontier  — the XLA blocked-gather path at several degree
                          blocks (the Pallas rejection arithmetic for a
                          per-edge-DMA formulation is printed alongside:
                          it is not implemented because its descriptor
@@ -103,13 +104,8 @@ def main():
     if interpret:
         _ROW_TAG["interpret_mode"] = True
 
-    from p2p_gossip_tpu.engine.sync import apply_tick_updates
     from p2p_gossip_tpu.ops import bitmask
-    from p2p_gossip_tpu.ops.pallas_kernels import (
-        coverage_per_slot_pallas,
-        tick_update_cov_pallas,
-        tick_update_pallas,
-    )
+    from p2p_gossip_tpu.ops.pallas_kernels import coverage_per_slot_pallas
 
     rng = np.random.default_rng(0)
 
@@ -144,73 +140,23 @@ def main():
             speedup=round(t_xla / t_pal, 3), parity="ok",
         )
 
-    # --- 2. fused tick update ------------------------------------------
-    n, w = args.rows, args.words
-    arrivals, seen0, gen_bits = rand_bits(n, w), rand_bits(n, w), rand_bits(n, w)
-    z = jnp.zeros((n,), dtype=jnp.int32)
-    deg = jnp.ones((n,), dtype=jnp.int32)
-    want = apply_tick_updates(seen0, arrivals, gen_bits, z, z, z, deg)
-    got = tick_update_pallas(arrivals, seen0, gen_bits, interpret=interpret)
-    assert np.array_equal(np.asarray(want[0]), np.asarray(got[0]))
-    assert np.array_equal(np.asarray(want[1]), np.asarray(got[1]))
-    assert np.array_equal(np.asarray(want[2]), np.asarray(got[2]))
-
-    def xla_tick(s):
-        out = apply_tick_updates(s, arrivals, gen_bits, z, z, z, deg)
-        return out[0] ^ out[1]
-
-    def pallas_tick(s):
-        sk, nk, _ = tick_update_pallas(arrivals, s, gen_bits, interpret=interpret)
-        return sk ^ nk
-
-    t_xla = chain_time(xla_tick, seen0, args.iters)
-    t_pal = chain_time(pallas_tick, seen0, args.iters)
-    bytes_min = 5 * n * w * 4  # 3 reads + 2 writes, the kernel's traffic
-    log(
-        f"tick-update N={n} W={w}: xla {t_xla*1e3:.2f} ms  pallas "
-        f"{t_pal*1e3:.2f} ms  (min-traffic {bytes_min/1e9:.2f} GB)"
+    # --- 2. (removed) fused tick update ------------------------------
+    # The fused Pallas tick-update kernels were benched on hardware by
+    # the round-4 battery (kernel stage, 2026-07-31): tick_update lost
+    # 0.50x and tick_update+coverage 0.60x against the fused XLA graph
+    # at 100K x 256 words — XLA already fuses the arrivals->newly->seen->
+    # popcount chain better than the hand tiling. Per the enable-or-
+    # delete rule the kernels are gone; the A/B rows live in
+    # docs/RESULTS.md and docs/artifacts/battery_20260731T031929Z.jsonl.
+    emit(
+        kernel="tick_update", status="removed",
+        note="lost 0.50x on hardware vs fused XLA (round-4 battery); "
+        "kernel deleted, XLA path is the product path",
     )
     emit(
-        kernel="tick_update", rows=n, words=w,
-        xla_ms=round(t_xla * 1e3, 3), pallas_ms=round(t_pal * 1e3, 3),
-        speedup=round(t_xla / t_pal, 3), parity="ok",
-        pallas_gbps=round(bytes_min / t_pal / 1e9, 1),
-    )
-
-    # --- 2b. fused tick update + coverage delta ------------------------
-    # The kernel _run_chunk_coverage actually executes at scale — its
-    # hardware validation is what PALLAS_TICK_MAX_ROWS records, so it
-    # must be exercised here, not inferred from the plain tick kernel.
-    slots_cov = args.words * 32
-    want_cov = np.asarray(bitmask.coverage_per_slot(np.asarray(want[1]), slots_cov))
-    got_cov = tick_update_cov_pallas(
-        arrivals, seen0, gen_bits, slots_cov, interpret=interpret
-    )
-    assert np.array_equal(np.asarray(want[0]), np.asarray(got_cov[0]))
-    assert np.array_equal(np.asarray(want[1]), np.asarray(got_cov[1]))
-    assert np.array_equal(want_cov, np.asarray(got_cov[3]))
-
-    def xla_tick_cov(s):
-        out = apply_tick_updates(s, arrivals, gen_bits, z, z, z, deg)
-        cov = bitmask.coverage_per_slot(out[1], slots_cov)
-        return out[0] ^ out[1] ^ cov[0].astype(jnp.uint32)
-
-    def pallas_tick_cov(s):
-        sk, nk, _, cov = tick_update_cov_pallas(
-            arrivals, s, gen_bits, slots_cov, interpret=interpret
-        )
-        return sk ^ nk ^ cov[0].astype(jnp.uint32)
-
-    t_xla = chain_time(xla_tick_cov, seen0, args.iters)
-    t_pal = chain_time(pallas_tick_cov, seen0, args.iters)
-    log(
-        f"tick-update+coverage N={n} W={w}: xla {t_xla*1e3:.2f} ms  "
-        f"pallas {t_pal*1e3:.2f} ms"
-    )
-    emit(
-        kernel="tick_update_cov", rows=n, words=w,
-        xla_ms=round(t_xla * 1e3, 3), pallas_ms=round(t_pal * 1e3, 3),
-        speedup=round(t_xla / t_pal, 3), parity="ok",
+        kernel="tick_update_cov", status="removed",
+        note="lost 0.60x on hardware vs fused XLA (round-4 battery); "
+        "kernel deleted, XLA path is the product path",
     )
 
     # --- 3. gather-OR (XLA path + the Pallas rejection arithmetic) -----
@@ -223,8 +169,11 @@ def main():
         # bucketed=True unconditionally: small --rows smoke runs fall
         # under the auto threshold but must exercise the same path.
         dg = DeviceGraph.build(g, bucketed=True)
+        w = args.words
         hist = rand_bits(2 * g.n, w).reshape(2, g.n, w)
-        for blk in (8, 32, 64):
+        # 128 rides along to test whether the round-1 sweep (which chose
+        # 64 from {8,16,32,64}) stopped short of the optimum.
+        for blk in (8, 32, 64, 128):
             def gather(h):
                 arr = propagate_bucketed(
                     h[0][None], jnp.int32(1), dg.buckets, n_out=g.n,

@@ -101,54 +101,37 @@ def main() -> None:
     # backends" iff devices() first fires after the 4 GB npz load).
     devices = jax.devices()
 
-    # Cache fingerprint: reusing a graph built for different flags would
+    # Cache handling: reusing a graph built for different flags would
     # attribute the benchmark to the wrong topology (same protection the
-    # CLI's --graphFile has). Pre-fingerprint caches (no fp key) load with
-    # a warning for back-compat with earlier runs.
-    from p2p_gossip_tpu.models.topology import (
-        load_graph_cache,
-        save_graph_cache,
+    # CLI's --graphFile has). The load/validate/build/save protocol and
+    # fingerprint are shared with mesh_rehearsal.py via
+    # load_or_build_graph_cache so the two scripts' caches interoperate.
+    from p2p_gossip_tpu.models.topology import load_or_build_graph_cache
+
+    def build():
+        t0 = time.perf_counter()
+        if args.topology == "ba":
+            graph = native.native_barabasi_albert(
+                args.nodes, m=args.baM, seed=args.seed
+            )
+            if graph is None:
+                graph = pg.barabasi_albert(
+                    args.nodes, m=args.baM, seed=args.seed
+                )
+            log(f"BA graph built: {time.perf_counter()-t0:.1f}s")
+        else:
+            graph = native.native_erdos_renyi(
+                args.nodes, args.prob, seed=args.seed
+            )
+            if graph is None:
+                graph = pg.erdos_renyi(args.nodes, args.prob, seed=args.seed)
+            log(f"graph built: {time.perf_counter()-t0:.1f}s")
+        return graph
+
+    graph = load_or_build_graph_cache(
+        args.cache, topology=args.topology, nodes=args.nodes, prob=args.prob,
+        ba_m=args.baM, seed=args.seed, build=build, log=log,
     )
-    from p2p_gossip_tpu.utils.checkpoint import fingerprint as _fp
-
-    graph_fp = _fp(
-        "scale_1m", args.topology, args.nodes, args.prob, args.baM, args.seed
-    )
-
-    def save_cache(graph):
-        save_graph_cache(args.cache, graph, fp=graph_fp)
-
-    t0 = time.perf_counter()
-    if args.cache and os.path.exists(args.cache):
-        try:
-            graph, cached_fp = load_graph_cache(args.cache)
-        except ValueError as e:
-            log(f"error: --cache {e}")
-            sys.exit(2)
-        if cached_fp is None:
-            log(f"WARNING: {args.cache} predates cache fingerprints — "
-                "assuming it matches the requested topology flags")
-        elif cached_fp != graph_fp:
-            log(f"error: {args.cache} was built with different topology "
-                "flags; delete it or match the original arguments")
-            sys.exit(2)
-        log(f"graph loaded from {args.cache}: {time.perf_counter()-t0:.1f}s")
-    elif args.topology == "ba":
-        graph = native.native_barabasi_albert(
-            args.nodes, m=args.baM, seed=args.seed
-        )
-        if graph is None:
-            graph = pg.barabasi_albert(args.nodes, m=args.baM, seed=args.seed)
-        log(f"BA graph built: {time.perf_counter()-t0:.1f}s")
-        if args.cache:
-            save_cache(graph)
-    else:
-        graph = native.native_erdos_renyi(args.nodes, args.prob, seed=args.seed)
-        if graph is None:
-            graph = pg.erdos_renyi(args.nodes, args.prob, seed=args.seed)
-        log(f"graph built: {time.perf_counter()-t0:.1f}s")
-        if args.cache:
-            save_cache(graph)
     log(
         f"N={graph.n} edges={graph.num_edges} dmax={graph.max_degree} "
         f"devices={devices}"

@@ -80,91 +80,8 @@ def test_coverage_rows_gate(monkeypatch):
     assert any("P2P_PALLAS_COVERAGE_MAX_ROWS" in str(x.message) for x in w)
 
 
-@pytest.mark.parametrize("n,w,row_tile", [(100, 2, 32), (777, 4, 256), (512, 1, 128)])
-def test_tick_update_kernel_matches_apply_tick_updates(n, w, row_tile):
-    """The fused tick kernel is bitwise-identical to the jnp formulation in
-    engine.sync.apply_tick_updates across row padding and tile shapes."""
-    from p2p_gossip_tpu.engine.sync import apply_tick_updates
-    from p2p_gossip_tpu.ops.pallas_kernels import tick_update_pallas
-
-    rng = np.random.default_rng(7)
-
-    def rand_bits():
-        return jnp.asarray(
-            rng.integers(0, 2**32, size=(n, w), dtype=np.uint64).astype(np.uint32)
-        )
-
-    arrivals, seen, gen_bits = rand_bits(), rand_bits(), rand_bits()
-    gen_cnt = jnp.asarray(rng.integers(0, 3, size=n, dtype=np.int32))
-    degree = jnp.asarray(rng.integers(1, 9, size=n, dtype=np.int32))
-    zeros = jnp.zeros((n,), dtype=jnp.int32)
-
-    want = apply_tick_updates(
-        seen, arrivals, gen_bits, gen_cnt, zeros, zeros, degree
-    )
-    seen_k, newly_k, cnt_k = tick_update_pallas(
-        arrivals, seen, gen_bits, row_tile=row_tile, interpret=True
-    )
-    np.testing.assert_array_equal(np.asarray(seen_k), np.asarray(want[0]))
-    np.testing.assert_array_equal(np.asarray(newly_k), np.asarray(want[1]))
-    np.testing.assert_array_equal(np.asarray(cnt_k), np.asarray(want[2]))
-
-
-def test_tick_update_kernel_edge_patterns():
-    from p2p_gossip_tpu.engine.sync import apply_tick_updates
-    from p2p_gossip_tpu.ops.pallas_kernels import tick_update_pallas
-
-    n, w = 64, 2
-    zeros_bits = jnp.zeros((n, w), dtype=jnp.uint32)
-    ones_bits = jnp.full((n, w), 0xFFFFFFFF, dtype=jnp.uint32)
-    z = jnp.zeros((n,), dtype=jnp.int32)
-    deg = jnp.ones((n,), dtype=jnp.int32)
-    for arr, sn, gb in [
-        (zeros_bits, zeros_bits, zeros_bits),
-        (ones_bits, zeros_bits, zeros_bits),
-        (ones_bits, ones_bits, zeros_bits),
-        (zeros_bits, zeros_bits, ones_bits),
-        (ones_bits, ones_bits, ones_bits),
-    ]:
-        want = apply_tick_updates(sn, arr, gb, z, z, z, deg)
-        got = tick_update_pallas(arr, sn, gb, row_tile=32, interpret=True)
-        np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))
-        np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
-        np.testing.assert_array_equal(np.asarray(got[2]), np.asarray(want[2]))
-
-
-def test_tick_rows_gate(monkeypatch):
-    from p2p_gossip_tpu.ops.pallas_kernels import tick_rows_ok
-
-    monkeypatch.delenv("P2P_PALLAS_TICK_MAX_ROWS", raising=False)
-    # Default 0: disabled until validated on hardware.
-    assert not tick_rows_ok(100)
-    monkeypatch.setenv("P2P_PALLAS_TICK_MAX_ROWS", "1000")
-    assert tick_rows_ok(1000) and not tick_rows_ok(1001)
-
-
-def test_tick_update_cov_kernel_matches_unfused():
-    """Fused tick+coverage kernel == tick_update_pallas + the per-slot
-    coverage of newly_out's first cov_w words."""
-    from p2p_gossip_tpu.ops.pallas_kernels import (
-        tick_update_cov_pallas,
-        tick_update_pallas,
-    )
-
-    rng = np.random.default_rng(11)
-    n, w, cov_slots = 700, 4, 96  # cov_w=3 < w
-    mk = lambda: jnp.asarray(  # noqa: E731
-        rng.integers(0, 2**32, size=(n, w), dtype=np.uint64).astype(np.uint32)
-    )
-    arrivals, seen, gen_bits = mk(), mk(), mk()
-    s1, n1, c1 = tick_update_pallas(
-        arrivals, seen, gen_bits, row_tile=128, interpret=True
-    )
-    s2, n2, c2, cov = tick_update_cov_pallas(
-        arrivals, seen, gen_bits, cov_slots, row_tile=128, interpret=True
-    )
-    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
-    np.testing.assert_array_equal(np.asarray(n1), np.asarray(n2))
-    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
-    want = bitmask.coverage_per_slot(jnp.asarray(n1)[:, :3], cov_slots)
-    np.testing.assert_array_equal(np.asarray(cov), np.asarray(want))
+# The fused tick-update kernels (tick_update_pallas, tick_update_cov_pallas)
+# and their interpret-mode parity tests were deleted after the round-4
+# on-chip bake-off measured them at 0.50x/0.60x of the fused XLA graph
+# (docs/RESULTS.md "Kernel bake-off") — apply_tick_updates' plain jnp
+# formulation IS the product path on every backend.

@@ -82,6 +82,33 @@ def test_scale_1m_cpu_flag_runs_and_labels_metric():
     assert "waiting up to" not in r.stderr
 
 
+def test_mesh_rehearsal_cache_roundtrip(tmp_path):
+    """--cache writes the graph with scale_1m.py's fingerprint scheme on
+    the first run and loads it on the second (the 1M rehearsal reuses the
+    north-star script's /tmp cache); --skip-parity rows still pass the
+    conservation check and report ring accounting."""
+    cache = str(tmp_path / "mesh.npz")
+    args = (
+        "mesh_rehearsal.py", "--nodes", "400", "--prob", "0.02",
+        "--shares", "4", "--horizon", "24", "--devices", "2",
+        "--skip-parity", "--cache", cache,
+    )
+    r = _run_script(*args)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert os.path.exists(cache)
+    rows = [json.loads(line) for line in r.stdout.strip().splitlines()]
+    assert {row["ring_mode"] for row in rows} == {"replicated", "sharded"}
+    for row in rows:
+        assert row["coverage_final_min"] == 400
+    r2 = _run_script(*args)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert "graph loaded from" in r2.stderr
+    assert [json.loads(l)["ring_bytes_per_chip"]
+            for l in r2.stdout.strip().splitlines()] == [
+        row["ring_bytes_per_chip"] for row in rows
+    ]
+
+
 def test_protocol_compare_cpu_flag():
     r = _run_script_cpu_flag(
         "protocol_compare.py", "--json", "--nodes", "200", "--prob", "0.03",

@@ -302,3 +302,60 @@ def test_parallel_link_extra_sparse_path_invariants():
         assert (resid == 0).all(), f"seed {seed}: unpaired extras {resid}"
     # With these parameters some seeds must exercise the quirk.
     assert total > 0
+
+
+def test_load_or_build_graph_cache_protocol(tmp_path, capsys):
+    """The shared cache protocol for the big-graph scripts
+    (scale_1m.py / mesh_rehearsal.py): build+save on first call, load on
+    the second, warn on a legacy fingerprint-less cache, clean
+    SystemExit(2) on a parameter mismatch. The ER fingerprint must not
+    depend on ba_m (it does not affect an ER build)."""
+    from p2p_gossip_tpu.models.topology import (
+        load_or_build_graph_cache,
+        save_graph_cache,
+        scale_graph_fingerprint,
+    )
+
+    logs = []
+    cache = str(tmp_path / "g.npz")
+    built = []
+
+    def build():
+        built.append(1)
+        return erdos_renyi(200, 0.03, seed=5)
+
+    kw = dict(topology="er", nodes=200, prob=0.03, ba_m=3, seed=5,
+              build=build, log=logs.append)
+    g1 = load_or_build_graph_cache(cache, **kw)
+    assert built == [1] and (tmp_path / "g.npz").exists()
+    g2 = load_or_build_graph_cache(cache, **kw)
+    assert built == [1]  # loaded, not rebuilt
+    assert g2.n == g1.n and np.array_equal(g2.indices, g1.indices)
+    assert any("graph loaded" in m for m in logs)
+
+    # ba_m is pinned out of ER fingerprints: a different --baM still loads.
+    g3 = load_or_build_graph_cache(cache, **{**kw, "ba_m": 9})
+    assert built == [1] and g3.n == g1.n
+
+    # Parameter mismatch -> clean exit 2.
+    with pytest.raises(SystemExit) as ei:
+        load_or_build_graph_cache(cache, **{**kw, "seed": 6})
+    assert ei.value.code == 2
+    assert any("different topology flags" in m for m in logs)
+
+    # Legacy cache without a fingerprint loads with a warning.
+    legacy = str(tmp_path / "legacy.npz")
+    save_graph_cache(legacy, g1)  # fp defaults to ""
+    logs.clear()
+    g4 = load_or_build_graph_cache(legacy, **kw)
+    assert g4.n == g1.n
+    assert any("predates cache fingerprints" in m for m in logs)
+
+    # Empty cache path: always build, never save.
+    built.clear()
+    load_or_build_graph_cache("", **kw)
+    assert built == [1]
+
+    # BA fingerprints DO depend on ba_m.
+    assert scale_graph_fingerprint("ba", 200, 0.03, 3, 5) != \
+        scale_graph_fingerprint("ba", 200, 0.03, 4, 5)
