@@ -87,14 +87,27 @@ def main() -> int:
     print(md_table(status_rows, ["stage", "rc", "wall_s", "results"]))
     print()
 
-    bench = by_stage.get("bench")
-    if bench and bench["results"]:
-        row = bench["results"][-1]
+    bench_rows = []
+    for stage in ("bench", "bench_rep2", "bench_rep3"):
+        rec = by_stage.get(stage)
+        if rec and rec["results"]:
+            bench_rows.append({"stage": stage, **rec["results"][-1]})
+    if bench_rows:
         print("## Headline bench\n")
-        print(md_table([row], [
-            "metric", "value", "unit", "vs_baseline", "achieved_gbps",
-            "pct_hbm_peak", "ticks",
+        print(md_table(bench_rows, [
+            "stage", "metric", "value", "unit", "vs_baseline",
+            "achieved_gbps", "pct_hbm_peak", "ticks",
         ]))
+        values = sorted(r["value"] for r in bench_rows)
+        if len(values) > 1:
+            # The variance line the repeat stages exist for: one number
+            # per window can't distinguish drift from noise.
+            spread = (values[-1] - values[0]) / values[-1] * 100
+            print(
+                f"\nacross {len(values)} runs: min {values[0]:.4g}, "
+                f"median {values[len(values) // 2]:.4g}, "
+                f"max {values[-1]:.4g} ({spread:.1f}% spread)"
+            )
         print()
 
     protocols = by_stage.get("protocols")

@@ -9,14 +9,21 @@ stage completes — a crash in stage k cannot cost stages 1..k-1.
 
 Stages (safest first; the known-crashy 1M run goes last by design):
 
-  bench     — bench.py on the real chip      -> the BENCH_r03 headline JSON
+  bench     — bench.py on the real chip      -> the headline BENCH JSON
   protocols — protocol_compare.py at 100K    -> flood/pushpull/pull/pushk table
               (standard XLA engines, low risk — before any Pallas runs)
   kernel    — kernel_bench.py at 100K rows   -> Pallas-vs-XLA A/B table
-  sweep250  — kernel_bench.py --rows 250000  -> coverage/tick A/B at 250K
+  sweep250  — kernel_bench.py --rows 250000  -> coverage A/B at 250K
   sweep500  — kernel_bench.py --rows 500000     (the 1M-crash bisection,
   sweep1m   — kernel_bench.py --rows 1000000     one process per row count
                                                  so a crash is attributable)
+  bench_rep2 — bench.py again                -> headline variance estimate:
+  bench_rep3 — bench.py again                   three records distinguish
+               drift from noise (round-1 5.60e8 vs round-4 4.41e8 was
+               undecidable from singles). After every unique artifact —
+               repeats are lower-value than never-captured evidence —
+               but before the crash-risk 1M stages, which would take the
+               repeats down with a wedge.
   scale1m   — scale_1m.py --cache --block 8  -> the 1M north-star JSON line
   scale1m_ba — scale_1m.py --topology ba     -> BASELINE config 4 (1M
                scale-free) JSON line; very last — same crash surface as
@@ -55,7 +62,7 @@ ART_DIR = os.path.join(REPO, "docs", "artifacts")
 
 STAGE_ORDER = (
     "bench", "protocols", "kernel", "sweep250", "sweep500", "sweep1m",
-    "scale1m", "scale1m_ba",
+    "bench_rep2", "bench_rep3", "scale1m", "scale1m_ba",
 )
 
 
@@ -96,12 +103,18 @@ def stage_specs(args) -> dict:
             py, os.path.join(SCRIPTS, "kernel_bench.py"),
             "--rows", "2000", "--words", "8", "--iters", "3",
         ]
-        return {
-            "bench": {
+        def bench_spec():
+            return {
                 "argv": [py, os.path.join(REPO, "bench.py")],
                 "env": {**cpu, "P2P_BENCH_SMOKE": "1"},
                 "budget": args.stage_budget or 900,
-            },
+            }
+
+        return {
+            # One spec for the headline bench and its variance repeats —
+            # a drifted copy would make the repeats measure a different
+            # configuration than the headline.
+            **{n: bench_spec() for n in ("bench", "bench_rep2", "bench_rep3")},
             "protocols": {
                 "argv": [
                     py, os.path.join(SCRIPTS, "protocol_compare.py"),
@@ -164,14 +177,19 @@ def stage_specs(args) -> dict:
         "P2P_DEVICE_WAIT_S": "600",
         "P2P_LONG_DEVICE_WAIT_S": "600",
     }
-    return {
-        "bench": {
+    def bench_spec():
+        return {
             "argv": [py, os.path.join(REPO, "bench.py")],
             # Bound the wait: the battery only starts a stage after a
             # healthy probe, so a long in-stage wait means a fresh wedge.
             "env": {"P2P_DEVICE_WAIT_S": "600"},
             "budget": args.stage_budget or 1800,
-        },
+        }
+
+    return {
+        # One spec for the headline bench and its variance repeats (same
+        # rationale as the smoke block).
+        **{n: bench_spec() for n in ("bench", "bench_rep2", "bench_rep3")},
         "protocols": {
             "argv": [
                 py, os.path.join(SCRIPTS, "protocol_compare.py"),
