@@ -13,7 +13,7 @@ committed to the repo, runnable by cron/nohup with no builder attached:
     audit log (docs/artifacts/watch.log by default), fsync'd, so even a
     round with zero tunnel uptime leaves proof the trap was armed;
   * on the first healthy probe it execs scripts/onchip_battery.py (full
-    battery, safest-first stages, per-stage JSONL artifacts) and logs
+    battery, value-first stage order, per-stage JSONL artifacts) and logs
     the battery's exit code;
   * a battery that exits nonzero (tunnel wedged mid-run, failed stage)
     puts the watcher back into probe mode after a cooldown, up to
