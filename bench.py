@@ -26,6 +26,47 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _latest_onchip_bench_record() -> dict | None:
+    """Latest committed real-TPU bench record from the battery artifacts
+    (docs/artifacts/battery_*.jsonl): stage == "bench", ok, non-smoke,
+    single-chip metric. Returns {"artifact", "value", "utc"} or None.
+    Never raises — a malformed artifact must not take the bench down."""
+    import glob
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    art_dir = os.path.join(repo, "docs", "artifacts")
+    best = None
+    # Robustness mirrors onchip_battery.latest_records: skip per file and
+    # per line (a crash-truncated record, a non-dict JSON line, or one
+    # unreadable artifact must not abort the scan or discard a best
+    # record already found). scripts/ is not a package, so the scan is
+    # local rather than imported.
+    for path in sorted(glob.glob(os.path.join(art_dir, "battery_*.jsonl"))):
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            try:
+                rec = json.loads(line)
+                if rec.get("stage") != "bench" or not rec.get("ok"):
+                    continue
+                for res in rec.get("results", []):
+                    metric = res.get("metric", "")
+                    if "single chip" not in metric or "SMOKE" in metric:
+                        continue
+                    if best is None or rec.get("utc", "") > best["utc"]:
+                        best = {
+                            "artifact": os.path.relpath(path, repo),
+                            "value": res.get("value"),
+                            "utc": rec.get("utc", ""),
+                        }
+            except Exception:
+                continue
+    return best
+
+
 def main() -> None:
     # A wedged TPU tunnel hangs in-process backend init; wait it out with
     # killable subprocess probes rather than losing the benchmark run. If
@@ -159,34 +200,41 @@ def main() -> None:
         f"{base_rate:.3g}/s"
     )
 
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"node-updates/sec ({n // 1000}K-node p={p:g} gossip "
-                    + (
-                        f"flood, CPU - {cpu_reason}"
-                        if cpu_fallback
-                        else "flood, single chip"
-                    )
-                    + (", SMOKE)" if smoke else ")")
-                ),
-                "value": round(tpu_rate, 1),
-                "unit": "node-updates/s",
-                "vs_baseline": round(tpu_rate / base_rate, 2),
-                "achieved_gbps": round(achieved_gbps, 1),
-                "pct_hbm_peak": (
-                    round(100 * achieved_gbps / peak_gbps, 1)
-                    if not (cpu_fallback or smoke)
-                    # Host run: the TPU peak is meaningless. Smoke run:
-                    # tiny shapes can't saturate HBM, the % would be
-                    # ingested as a real roofline figure.
-                    else None
-                ),
-                "ticks": ticks,
-            }
-        )
-    )
+    row = {
+        "metric": (
+            f"node-updates/sec ({n // 1000}K-node p={p:g} gossip "
+            + (
+                f"flood, CPU - {cpu_reason}"
+                if cpu_fallback
+                else "flood, single chip"
+            )
+            + (", SMOKE)" if smoke else ")")
+        ),
+        "value": round(tpu_rate, 1),
+        "unit": "node-updates/s",
+        "vs_baseline": round(tpu_rate / base_rate, 2),
+        "achieved_gbps": round(achieved_gbps, 1),
+        "pct_hbm_peak": (
+            round(100 * achieved_gbps / peak_gbps, 1)
+            if not (cpu_fallback or smoke)
+            # Host run: the TPU peak is meaningless. Smoke run:
+            # tiny shapes can't saturate HBM, the % would be
+            # ingested as a real roofline figure.
+            else None
+        ),
+        "ticks": ticks,
+    }
+    if cpu_fallback and not smoke:
+        # A wedged tunnel at capture time must not erase on-chip evidence
+        # that already exists: cite the battery's latest real-TPU bench
+        # record (docs/artifacts/, committed) so a fallback artifact
+        # still points the reader at the measured chip number.
+        onchip = _latest_onchip_bench_record()
+        if onchip is not None:
+            row["onchip_artifact"] = onchip["artifact"]
+            row["onchip_value"] = onchip["value"]
+            row["onchip_utc"] = onchip["utc"]
+    print(json.dumps(row))
 
 
 if __name__ == "__main__":

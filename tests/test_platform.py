@@ -169,6 +169,52 @@ def test_bench_fallback_fires_inside_budget(tmp_path):
     # The fallback must actually have fired and be honestly labeled.
     assert "falling back" in proc.stderr
     assert "CPU" in parsed["metric"] and "SMOKE" in parsed["metric"]
+    # Smoke fallbacks never cite on-chip evidence (a smoke JSON is a
+    # machinery check, not a measurement record).
+    assert "onchip_value" not in parsed
+
+
+def test_bench_onchip_citation_helper():
+    """A non-smoke CPU fallback cites the battery's latest committed
+    real-TPU bench record so a wedged tunnel at capture time can't erase
+    on-chip evidence that already exists. The helper must pick only ok,
+    non-smoke, single-chip records and never raise."""
+    import bench
+
+    rec = bench._latest_onchip_bench_record()
+    # The round-4 artifact is committed in docs/artifacts; the helper
+    # must find it (value + repo-relative path + utc).
+    assert rec is not None
+    assert rec["artifact"].startswith("docs/artifacts/battery_")
+    assert rec["value"] > 0 and rec["utc"]
+
+    # Malformed artifact lines (non-dict JSON, truncation, bad results
+    # entries) must be skipped, not raise — drop hostile files into the
+    # real art dir via monkeypatched glob? Simpler: point the scan at a
+    # copy of the dir plus a poison file and re-run.
+    import shutil
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        art = os.path.join(td, "docs", "artifacts")
+        os.makedirs(art)
+        real_art = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "docs", "artifacts",
+        )
+        for f in os.listdir(real_art):
+            if f.startswith("battery_") and f.endswith(".jsonl"):
+                shutil.copy(os.path.join(real_art, f), os.path.join(art, f))
+        with open(os.path.join(art, "battery_zz_poison.jsonl"), "w") as f:
+            f.write('123\n[]\n{"stage": "bench", "ok": true, '
+                    '"results": ["x"]}\n{"trunca')
+        real_file = bench.__file__
+        try:
+            bench.__file__ = os.path.join(td, "bench.py")
+            rec2 = bench._latest_onchip_bench_record()
+        finally:
+            bench.__file__ = real_file
+        assert rec2 is not None and rec2["value"] == rec["value"]
 
 
 def test_entry_compile_check_falls_back_to_cpu(tmp_path):
