@@ -63,6 +63,104 @@ def make_mesh(
     return Mesh(dev_array, (SHARES_AXIS, NODES_AXIS))
 
 
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> tuple[int, int]:
+    """Multi-host bootstrap — the role NCCL/MPI init plays in a
+    GPU-cluster framework, done the JAX way: one
+    ``jax.distributed.initialize`` per process, after which
+    ``jax.devices()`` spans every host and the same ``shard_map`` engine
+    code runs unchanged with XLA routing collectives over ICI within a
+    slice and DCN across slices.
+
+    On TPU pods (and Slurm/GKE) every argument autodetects from the
+    environment — call with no arguments BEFORE anything touches the
+    XLA backend. Idempotent (a second call is a no-op), and a plain
+    single-process run with nothing to autodetect degrades cleanly.
+    An out-of-order call (backend already initialized by earlier device
+    use) raises — silently degrading a pod launch to N independent
+    single-process sims would corrupt results on every host.
+    Returns ``(process_index, process_count)``."""
+    already = False
+    try:
+        from jax._src import distributed as _dist
+
+        already = getattr(_dist.global_state, "client", None) is not None
+    except ImportError:  # private-module layout changed; fall through
+        pass
+    if not already:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        except RuntimeError as e:
+            msg = str(e).lower()
+            if "already initialized" in msg:
+                pass  # raced another caller — fine
+            elif "before any jax calls" in msg:
+                # The ordering bug, explicit args or not: the backend
+                # was touched first, so a real pod launch can no longer
+                # be wired up. Never swallow.
+                raise
+            elif coordinator_address or num_processes:
+                raise  # explicit config rejected — a real error
+            # else: no-arg call with nothing to autodetect — a plain
+            # single-process run; jax works fine un-distributed.
+    return jax.process_index(), jax.process_count()
+
+
+def make_multihost_mesh(
+    n_node_shards: int | None = None,
+    n_share_shards: int | None = None,
+) -> Mesh:
+    """(shares, nodes) mesh over ALL processes' devices, axes placed for
+    the interconnect hierarchy:
+
+    - the **shares** axis spans DCN (host-to-host): share shards are
+      embarrassingly parallel — zero per-tick communication, one counter
+      ``psum`` at the end — so the slow network carries almost nothing;
+    - the **nodes** axis stays inside each process's local devices (a
+      slice's ICI): it carries the per-tick frontier ``all_gather``.
+
+    Defaults: one share shard per process, nodes axis = one process's
+    local devices (``process_is_granule`` — on a multi-host slice each
+    host is its own granule, so the layout also holds when several
+    processes share a slice). Falls back to the plain ``make_mesh``
+    device policy when not actually distributed."""
+    nproc = jax.process_count()
+    if nproc > 1:
+        from jax.experimental import mesh_utils
+
+        devices = jax.devices()
+        per_process_nodes = len(jax.local_devices())
+        if n_share_shards is None:
+            n_share_shards = nproc
+        if n_node_shards is None:
+            n_node_shards = len(devices) // n_share_shards
+        if (
+            n_share_shards == nproc
+            and n_node_shards == per_process_nodes
+        ):
+            # Canonical layout: granule = process, shares across
+            # granules (DCN), nodes within each granule's devices.
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=(1, n_node_shards),
+                dcn_mesh_shape=(n_share_shards, 1),
+                devices=devices,
+                process_is_granule=True,
+            )
+            return Mesh(dev_array, (SHARES_AXIS, NODES_AXIS))
+        return make_mesh(n_node_shards, n_share_shards, devices=devices)
+    # Single process: inherit make_mesh's device-selection policy
+    # (JAX_PLATFORMS / default-device pollution guard) by NOT passing a
+    # bare jax.devices() list through.
+    return make_mesh(n_node_shards, n_share_shards or 1)
+
+
 def pad_to_multiple(x: np.ndarray, multiple: int, axis: int = 0, fill=0):
     """Pad an array so its ``axis`` length divides evenly across shards."""
     size = x.shape[axis]

@@ -224,3 +224,76 @@ def test_split_ell_by_delay_partitions_edges():
         (int(r), int(ell_idx[r, c])) for r, c in zip(*np.nonzero(ell_mask))
     }
     assert seen_pairs == expect
+
+
+def test_multihost_bootstrap_and_mesh(tmp_path):
+    """initialize_multihost + make_multihost_mesh single-process path:
+    the distributed bootstrap must leave jax usable, the mesh must carry
+    the canonical (shares, nodes) axes, and the sharded engine must run
+    on it with oracle-identical counters. Runs in a subprocess because
+    jax.distributed.initialize is process-global state."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    code = textwrap.dedent("""
+        # Bootstrap FIRST: jax.distributed.initialize must run before
+        # anything touches the XLA backend (importing the package pulls
+        # in modules that do) — the same ordering a pod launcher needs.
+        from p2p_gossip_tpu.parallel.mesh import (
+            NODES_AXIS, SHARES_AXIS, initialize_multihost,
+            make_multihost_mesh,
+        )
+
+        # Explicit single-process coordinator: the code path a pod
+        # launcher runs, shrunk to one process.
+        idx, count = initialize_multihost("localhost:19357", 1, 0)
+        assert (idx, count) == (0, 1), (idx, count)
+        # Second call must be a no-op, not a crash.
+        assert initialize_multihost("localhost:19357", 1, 0) == (0, 1)
+
+        import numpy as np
+        import p2p_gossip_tpu as pg
+
+        mesh = make_multihost_mesh(n_node_shards=4, n_share_shards=2)
+        assert mesh.axis_names == (SHARES_AXIS, NODES_AXIS)
+        assert mesh.devices.shape == (2, 4)
+
+        from p2p_gossip_tpu.engine.event import run_event_sim
+        from p2p_gossip_tpu.parallel.engine_sharded import run_sharded_sim
+
+        g = pg.erdos_renyi(60, 0.1, seed=4)
+        sched = pg.uniform_renewal_schedule(
+            60, sim_time=1.2, tick_dt=0.005, seed=4
+        )
+        sh = run_sharded_sim(g, sched, 300, mesh, chunk_size=32)
+        ev = run_event_sim(g, sched, 300)
+        assert sh.equal_counts(ev), "multihost-mesh engine diverged"
+        print("MULTIHOST-OK")
+    """)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # Extend, don't overwrite: the parent env may carry flags/paths the
+    # child needs to import its dependencies.
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # ...except the TPU-plugin sitecustomize path: it registers the
+    # tunnel backend at interpreter startup, before the child can
+    # deregister it, and the first device query then dials a possibly
+    # wedged tunnel (same filter tests/conftest.py applies to itself).
+    keep = [
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p
+    ]
+    env["PYTHONPATH"] = os.pathsep.join([repo, *keep])
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=420, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "MULTIHOST-OK" in r.stdout
