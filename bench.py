@@ -56,6 +56,8 @@ def _latest_onchip_bench_record() -> dict | None:
                     metric = res.get("metric", "")
                     if "single chip" not in metric or "SMOKE" in metric:
                         continue
+                    if res.get("profiled"):
+                        continue  # tracing overhead skews the number
                     if best is None or rec.get("utc", "") > best["utc"]:
                         best = {
                             "artifact": os.path.relpath(path, repo),
@@ -146,9 +148,33 @@ def main() -> None:
     warm = run_sync_sim(graph, sched, horizon, chunk_size=chunk_size, device_graph=dg)
     log(f"warmup (incl. compile): {time.perf_counter() - t0:.1f}s")
 
+    profile_dir = os.environ.get("P2P_BENCH_PROFILE_DIR", "")
     t0 = time.perf_counter()
-    stats = run_sync_sim(graph, sched, horizon, chunk_size=chunk_size, device_graph=dg)
-    tpu_wall = time.perf_counter() - t0
+    if profile_dir:
+        # Opt-in profiler capture of the timed pass: the captured trace
+        # is how the modeled hbm_bytes_per_tick roofline gets calibrated
+        # against MEASURED HBM throughput (round-3 verdict item 5).
+        # Env-var rather than a flag so the battery can enable it
+        # per-stage without changing any argv contract; not on by
+        # default because tracing through the tunnel is unvalidated.
+        # The wall clock stops INSIDE the context — run_sync_sim forces
+        # its counters to host, and trace finalization/serialization
+        # after it must not count as simulation time — and the JSON row
+        # is stamped "profiled" so per-op tracing overhead can never
+        # pass for a clean bench number downstream.
+        import jax.profiler
+
+        with jax.profiler.trace(profile_dir):
+            stats = run_sync_sim(
+                graph, sched, horizon, chunk_size=chunk_size, device_graph=dg
+            )
+            tpu_wall = time.perf_counter() - t0
+        log(f"profiler trace written to {profile_dir}")
+    else:
+        stats = run_sync_sim(
+            graph, sched, horizon, chunk_size=chunk_size, device_graph=dg
+        )
+        tpu_wall = time.perf_counter() - t0
     processed = stats.totals()["processed"]
     assert stats.totals() == warm.totals()
     assert processed == n_shares * graph.n, "flood did not reach full coverage"
@@ -224,6 +250,10 @@ def main() -> None:
         ),
         "ticks": ticks,
     }
+    if profile_dir:
+        # Tracing adds per-op overhead: mark the row so artifact pickers
+        # (and readers) never mistake a profiled number for a clean one.
+        row["profiled"] = True
     if cpu_fallback and not smoke:
         # A wedged tunnel at capture time must not erase on-chip evidence
         # that already exists: cite the battery's latest real-TPU bench
