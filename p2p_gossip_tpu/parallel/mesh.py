@@ -116,6 +116,7 @@ def initialize_multihost(
 def make_multihost_mesh(
     n_node_shards: int | None = None,
     n_share_shards: int | None = None,
+    devices=None,
 ) -> Mesh:
     """(shares, nodes) mesh over ALL processes' devices, axes placed for
     the interconnect hierarchy:
@@ -130,12 +131,15 @@ def make_multihost_mesh(
     local devices (``process_is_granule`` — on a multi-host slice each
     host is its own granule, so the layout also holds when several
     processes share a slice). Falls back to the plain ``make_mesh``
-    device policy when not actually distributed."""
+    device policy when not actually distributed. ``devices`` (optional)
+    pins an explicit device list — e.g. a caller that already resolved a
+    host-CPU fallback set — instead of the global ``jax.devices()``."""
     nproc = jax.process_count()
     if nproc > 1:
         from jax.experimental import mesh_utils
 
-        devices = jax.devices()
+        if devices is None:
+            devices = jax.devices()
         per_process_nodes = len(jax.local_devices())
         if n_share_shards is None:
             n_share_shards = nproc
@@ -155,10 +159,11 @@ def make_multihost_mesh(
             )
             return Mesh(dev_array, (SHARES_AXIS, NODES_AXIS))
         return make_mesh(n_node_shards, n_share_shards, devices=devices)
-    # Single process: inherit make_mesh's device-selection policy
+    # Single process: an explicit device list passes straight through;
+    # otherwise inherit make_mesh's device-selection policy
     # (JAX_PLATFORMS / default-device pollution guard) by NOT passing a
-    # bare jax.devices() list through.
-    return make_mesh(n_node_shards, n_share_shards or 1)
+    # bare jax.devices() list down.
+    return make_mesh(n_node_shards, n_share_shards or 1, devices=devices)
 
 
 def pad_to_multiple(x: np.ndarray, multiple: int, axis: int = 0, fill=0):
