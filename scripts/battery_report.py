@@ -151,8 +151,9 @@ def main() -> int:
             print()
 
     for stage, title in (
-        ("scale1m", "1M north star (ER p=0.001)"),
+        ("scale1m", "1M north star (ER p=0.001, 64-share staging plan)"),
         ("scale1m_ba", "1M scale-free (BA m=3)"),
+        ("scale1m_full", "1M north star, full config (ER, 4096 shares)"),
     ):
         rec = by_stage.get(stage)
         if rec and rec["results"]:

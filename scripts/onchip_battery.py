@@ -17,18 +17,32 @@ Stages (value-first within safety bands — see the note after the list):
   bench_rep3 — bench.py again                   three records distinguish
                drift from noise (round-1 5.60e8 vs round-4 4.41e8 was
                undecidable from singles); cheap (~90 s each) and safe.
-  scale1m   — scale_1m.py --cache --block 8  -> the 1M north-star JSON line
+  scale1m   — scale_1m.py --shares 64        -> the 1M ER on-chip line at
+               the host-proven staging plan (the CPU run's exact shape:
+               64 shares, block 8 — docs/RESULTS.md). The full-config
+               attempt lives in scale1m_full, LAST, because it crashed
+               the TPU worker on 2026-07-31 (window #3) and a crash
+               wedges the tunnel for every stage after it.
   scale1m_ba — scale_1m.py --topology ba     -> BASELINE config 4 (1M
                scale-free) JSON line
   sweep250  — kernel_bench.py --rows 250000  -> coverage A/B row sweep.
-  sweep500  — kernel_bench.py --rows 500000     Last on purpose: since the
-  sweep1m   — kernel_bench.py --rows 1000000    round-4 bake-off gated the
-               coverage kernel at its measured 100K crossover, no product
-               path runs it at these sizes — the sweep is for-the-record
-               characterization, worth less than any stage above it. (It
-               was ordered before the 1M stages when it doubled as the
-               1M-crash bisection of a then-enabled kernel; with the
-               kernel off at 1M, a scale1m crash no longer implicates it.)
+  sweep500  — kernel_bench.py --rows 500000     Near-last on purpose:
+  sweep1m   — kernel_bench.py --rows 1000000    since the round-4
+               bake-off gated the coverage kernel at its measured 100K
+               crossover, no product path runs it at these sizes — the
+               sweep is for-the-record characterization, worth less than
+               any stage above it. (It was ordered before the 1M stages
+               when it doubled as the 1M-crash bisection of a
+               then-enabled kernel; with the kernel off at 1M, a scale1m
+               crash no longer implicates it.)
+  scale1m_full — scale_1m.py at the full default config (ER 1M, 4096
+               shares). Dead last: this exact invocation crashed the TPU
+               worker in window #3 (battery_latest.jsonl stage scale1m,
+               rc=1, JaxRuntimeError "TPU worker process crashed", after
+               graph build + staging succeeded — suspect is HBM/tunnel
+               pressure at W=128, not Pallas, which is gated off at 1M).
+               Keep attempting it once per window, but never at the cost
+               of an uncaptured stage above.
 
 Observed tunnel windows are ~50 min; the order above is value-first
 within safety bands so a short window always banks the most important
@@ -68,6 +82,7 @@ ART_DIR = os.path.join(REPO, "docs", "artifacts")
 STAGE_ORDER = (
     "bench", "protocols", "kernel", "bench_rep2", "bench_rep3",
     "scale1m", "scale1m_ba", "sweep250", "sweep500", "sweep1m",
+    "scale1m_full",
 )
 
 
@@ -160,6 +175,15 @@ def stage_specs(args) -> dict:
                 "env": cpu,
                 "budget": args.stage_budget or 900,
             },
+            "scale1m_full": {
+                "argv": [
+                    py, os.path.join(SCRIPTS, "scale_1m.py"),
+                    "--nodes", "2000", "--prob", "0.01", "--shares", "128",
+                    "--horizon", "32", "--block", "8",
+                ],
+                "env": cpu,
+                "budget": args.stage_budget or 900,
+            },
             "scale1m_ba": {
                 "argv": [
                     py, os.path.join(SCRIPTS, "scale_1m.py"),
@@ -233,6 +257,19 @@ def stage_specs(args) -> dict:
             "budget": args.stage_budget or 1800,
         },
         "scale1m": {
+            # The host-proven staging plan (docs/RESULTS.md 1M table):
+            # 64 shares (W=2) keeps the per-tick gather at ~10 GB and
+            # every resident buffer far under HBM. The full 4096-share
+            # config is scale1m_full, last.
+            "argv": [
+                py, os.path.join(SCRIPTS, "scale_1m.py"),
+                "--shares", "64",
+                "--cache", args.cache, "--block", str(args.block),
+            ],
+            "env": sweep_env,
+            "budget": args.stage_budget or 3600,
+        },
+        "scale1m_full": {
             "argv": [
                 py, os.path.join(SCRIPTS, "scale_1m.py"),
                 "--cache", args.cache, "--block", str(args.block),
@@ -243,10 +280,14 @@ def stage_specs(args) -> dict:
         "scale1m_ba": {
             # BASELINE config 4: 1M-node scale-free. Mean degree ~2m is
             # far below the ER north star's ~1000, but the hub rows give
-            # the degree-bucketed gather its worst-case skew.
+            # the degree-bucketed gather its worst-case skew. Pinned to
+            # the host-proven 64-share shape for the same reason as
+            # scale1m: the W=128 crash suspect (N x W frontier/coverage
+            # buffers) is topology-independent, and a worker crash here
+            # would wedge every later stage.
             "argv": [
                 py, os.path.join(SCRIPTS, "scale_1m.py"),
-                "--topology", "ba", "--baM", "3",
+                "--topology", "ba", "--baM", "3", "--shares", "64",
                 "--cache", args.ba_cache, "--block", str(args.block),
             ],
             "env": sweep_env,
