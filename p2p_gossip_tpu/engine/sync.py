@@ -157,6 +157,85 @@ class DeviceGraph:
         return gather + elementwise
 
 
+def flood_resident_hbm_bytes(
+    degree: np.ndarray,
+    w: int,
+    block: int,
+    ring_size: int = 2,
+    uniform_delay: bool = True,
+) -> int:
+    """Modeled peak RESIDENT device memory of one flood chunk at W words —
+    the fit check, where ``hbm_bytes_per_tick`` is the traffic model.
+    Computable from the host-side degree array BEFORE staging, so callers
+    can size the share chunk without building a DeviceGraph first.
+
+    Terms (all bytes):
+      * ELL staging — bucketed rows pad each node to ceil(d/block)*block
+        entries of int32 index + bool mask (+ int32 delay when per-edge);
+        resident for the whole run, independent of W.
+      * blocked gather — one scan step materializes (rows, block, W)
+        uint32 plus the (rows, W) OR accumulator.
+      * persistent state — the (ring, N, W) frontier-history ring and the
+        (N, W) seen bitmask.
+      * scratch — arrivals/newly/gen frontier copies alive across a tick
+        (~3 more (N, W) buffers).
+
+    Validation point: at the 1M-node ER north star (mean degree ~1000,
+    block 8, W=128) this models ~12.6 GB — the configuration that crashed
+    the 16 GB v5e worker on 2026-07-31 (docs/RESULTS.md); at W=64 it
+    models ~8.8 GB. A model, not a measurement: XLA workspace, transfer
+    staging, and fusion choices move the true number by O(GB)."""
+    degree = np.asarray(degree, dtype=np.int64)
+    n = int(degree.shape[0])
+    entries = int((-(-degree // block) * block).sum())
+    row = w * 4
+    ell = entries * 5 + (0 if uniform_delay else entries * 4)
+    gather = n * (block + 1) * row
+    state = (ring_size + 1) * n * row
+    scratch = 3 * n * row
+    return ell + gather + state + scratch
+
+
+def auto_chunk_shares(
+    degree: np.ndarray,
+    shares: int,
+    block: int,
+    budget_bytes: float,
+    ring_size: int = 2,
+    uniform_delay: bool = True,
+    min_chunk: int = 512,
+) -> int | None:
+    """Bitmask pad width (in shares) whose modeled resident footprint
+    (``flood_resident_hbm_bytes``) fits ``budget_bytes`` — or ``None``
+    when the engine's default lane pad (``max(shares, MIN_CHUNK_SHARES)``,
+    what run_flood_coverage would stage anyway) already fits, or when
+    budgeting is disabled (``budget_bytes`` falsy). None tells the caller
+    to leave ``chunk_size`` at its default so an enabled-but-satisfied
+    budget changes nothing observable.
+
+    When the default pad does NOT fit, halves from it as little as
+    possible: narrow chunks underfill the 128-lane tile (the
+    MIN_CHUNK_SHARES rationale — measured ~15x worse gather bytes/s at 32
+    words vs 128), so each halving trades bandwidth efficiency for
+    fitting at all. Floors at ``min_chunk`` — below that the model's
+    fixed terms (the ELL) dominate and halving further cannot help. The
+    returned value may exceed ``shares`` (e.g. 64 shares at the 1M shape
+    returns 2048): it is the PAD target, so the caller still runs one
+    64-origin pass, just at the widest W that fits."""
+    if not budget_bytes:
+        return None
+    default_pad = max(32, shares, MIN_CHUNK_SHARES)
+    chunk = default_pad
+    while chunk > min_chunk:
+        w = bitmask.num_words(chunk)
+        if flood_resident_hbm_bytes(
+            degree, w, block, ring_size, uniform_delay
+        ) <= budget_bytes:
+            break
+        chunk = max(min_chunk, chunk // 2)
+    return None if chunk == default_pad else chunk
+
+
 def _resolve_block(dg: DeviceGraph, block: int | None) -> int:
     """``block=None`` means auto: the swept TPU optimum capped by the staged
     max degree (`ops.ell.tuned_degree_block`). Results are bitwise identical
@@ -637,16 +716,24 @@ def run_flood_coverage(
     device_graph: DeviceGraph | None = None,
     churn=None,
     loss=None,
+    chunk_size: int | None = None,
 ):
     """Flood coverage-time experiment: one share per origin, all at t=0.
 
     Returns (stats, coverage) where coverage is (horizon, num_origins) node
     counts per tick — the time-to-99%-share-coverage curve from
     BASELINE.json's headline config.
+
+    ``chunk_size=None`` pads the bitmask to MIN_CHUNK_SHARES for full
+    128-lane tiles; an explicit smaller value is honored (same contract as
+    run_sync_sim) so memory-bound shapes — the 1M-node north star, where
+    every (N, W) buffer at W=128 costs 512 MB — can trade gather
+    bandwidth for fitting in HBM (see flood_resident_hbm_bytes).
     """
     origins = np.asarray(origins, dtype=np.int32).reshape(-1)
     s = origins.shape[0]
-    chunk_size = bitmask.num_words(max(s, MIN_CHUNK_SHARES)) * bitmask.WORD_BITS
+    floor = MIN_CHUNK_SHARES if chunk_size is None else chunk_size
+    chunk_size = bitmask.num_words(max(s, floor)) * bitmask.WORD_BITS
     dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
     block = _resolve_block(dg, block)
     sched = Schedule(graph.n, origins, np.zeros(s, dtype=np.int32))
@@ -657,12 +744,21 @@ def run_flood_coverage(
     from p2p_gossip_tpu.ops.pallas_kernels import coverage_rows_ok
 
     on_tpu = any(d.platform == "tpu" for d in dg.ell_idx.devices())
-    use_pallas = on_tpu and coverage_rows_ok(dg.n)
+    # The W >= 128 gate: every on-chip validation of the Pallas coverage
+    # kernel ran at >= 128 words (full 128-lane tiles); an explicit small
+    # chunk_size can now produce sub-lane W, a Mosaic shape never
+    # compiled on hardware — keep those on the XLA path.
+    w_words = bitmask.num_words(chunk_size)
+    use_pallas = on_tpu and coverage_rows_ok(dg.n) and w_words >= 128
     if on_tpu and not use_pallas:
-        log.info(
-            f"coverage: Pallas kernel on the XLA path (N={dg.n} exceeds "
-            "PALLAS_COVERAGE_MAX_ROWS, the measured 100K crossover)"
+        reason = (
+            f"N={dg.n} exceeds PALLAS_COVERAGE_MAX_ROWS, the measured "
+            "100K crossover"
+            if not coverage_rows_ok(dg.n)
+            else f"W={w_words} words under the 128-lane tile, a shape "
+            "never validated on hardware"
         )
+        log.info(f"coverage: Pallas kernel on the XLA path ({reason})")
     churn_dev = churn_to_device(churn)
     loss_cfg = loss.static_cfg if loss is not None else None
     _, r, snt, cov = _run_chunk_coverage(

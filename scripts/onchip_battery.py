@@ -17,12 +17,13 @@ Stages (value-first within safety bands — see the note after the list):
   bench_rep3 — bench.py again                   three records distinguish
                drift from noise (round-1 5.60e8 vs round-4 4.41e8 was
                undecidable from singles); cheap (~90 s each) and safe.
-  scale1m   — scale_1m.py --shares 64        -> the 1M ER on-chip line at
-               the host-proven staging plan (the CPU run's exact shape:
-               64 shares, block 8 — docs/RESULTS.md). The full-config
-               attempt lives in scale1m_full, LAST, because it crashed
-               the TPU worker on 2026-07-31 (window #3) and a crash
-               wedges the tunnel for every stage after it.
+  scale1m   — scale_1m.py --shares 64 --chunk 64 -> the 1M ER on-chip
+               line at the minimal resident footprint (pad W=2, ~5.2 GB
+               modeled = essentially the bare ELL). The full-config
+               attempt lives in scale1m_full, LAST, because its W=128
+               one-pass shape crashed the TPU worker on 2026-07-31
+               (window #3) and a crash wedges the tunnel for every
+               stage after it.
   scale1m_ba — scale_1m.py --topology ba     -> BASELINE config 4 (1M
                scale-free) JSON line
   sweep250  — kernel_bench.py --rows 250000  -> coverage A/B row sweep.
@@ -36,13 +37,16 @@ Stages (value-first within safety bands — see the note after the list):
                then-enabled kernel; with the kernel off at 1M, a scale1m
                crash no longer implicates it.)
   scale1m_full — scale_1m.py at the full default config (ER 1M, 4096
-               shares). Dead last: this exact invocation crashed the TPU
+               shares). Dead last: this invocation crashed the TPU
                worker in window #3 (battery_latest.jsonl stage scale1m,
                rc=1, JaxRuntimeError "TPU worker process crashed", after
-               graph build + staging succeeded — suspect is HBM/tunnel
-               pressure at W=128, not Pallas, which is gated off at 1M).
-               Keep attempting it once per window, but never at the cost
-               of an uncaptured stage above.
+               graph build + staging succeeded — the resident-HBM model
+               puts the one-pass W=128 footprint at ~12.6 GB on a 16 GB
+               chip; Pallas is gated off at 1M, so it is not implicated).
+               scale_1m.py now auto-chunks against P2P_HBM_BUDGET_GB
+               (4096 shares -> 2x 2048-share passes, ~8.8 GB modeled),
+               which should make this stage survivable — but it stays
+               last until a window proves that.
 
 Observed tunnel windows are ~50 min; the order above is value-first
 within safety bands so a short window always banks the most important
@@ -257,13 +261,16 @@ def stage_specs(args) -> dict:
             "budget": args.stage_budget or 1800,
         },
         "scale1m": {
-            # The host-proven staging plan (docs/RESULTS.md 1M table):
-            # 64 shares (W=2) keeps the per-tick gather at ~10 GB and
-            # every resident buffer far under HBM. The full 4096-share
-            # config is scale1m_full, last.
+            # The minimal-footprint rung of the 1M ladder: --chunk 64
+            # pins the pad to W=2, so resident memory is essentially the
+            # bare staged ELL (~5.2 GB modeled) — the least the 1M graph
+            # can occupy at all. Slow per gathered byte (sub-lane W) but
+            # the job is 64 origins; what it buys is the first-ever 1M
+            # on-chip completion at the lowest possible crash risk. The
+            # auto-chunked ~8.8 GB shape is scale1m_full's job, last.
             "argv": [
                 py, os.path.join(SCRIPTS, "scale_1m.py"),
-                "--shares", "64",
+                "--shares", "64", "--chunk", "64",
                 "--cache", args.cache, "--block", str(args.block),
             ],
             "env": sweep_env,
@@ -281,13 +288,15 @@ def stage_specs(args) -> dict:
             # BASELINE config 4: 1M-node scale-free. Mean degree ~2m is
             # far below the ER north star's ~1000, but the hub rows give
             # the degree-bucketed gather its worst-case skew. Pinned to
-            # the host-proven 64-share shape for the same reason as
-            # scale1m: the W=128 crash suspect (N x W frontier/coverage
-            # buffers) is topology-independent, and a worker crash here
-            # would wedge every later stage.
+            # the minimal W=2 pad for the same reason as scale1m: the
+            # W=128 crash suspect (N x W frontier/scratch buffers) is
+            # topology-independent — even with BA's tiny ELL the default
+            # pad models ~7.7 GB — and a worker crash here would wedge
+            # every later stage.
             "argv": [
                 py, os.path.join(SCRIPTS, "scale_1m.py"),
                 "--topology", "ba", "--baM", "3", "--shares", "64",
+                "--chunk", "64",
                 "--cache", args.ba_cache, "--block", str(args.block),
             ],
             "env": sweep_env,

@@ -43,9 +43,14 @@ def main() -> None:
     ap.add_argument("--shares", type=int, default=4096)
     ap.add_argument(
         "--chunk", type=int, default=0,
-        help="Shares per device pass (0 = all at once). Chunks below 4096 "
-        "shares drop the row gather under the TPU's 128-lane tile width — "
-        "prefer --block for memory relief.",
+        help="Shares per device pass (0 = auto). Auto sizes the chunk from "
+        "the resident-HBM model (engine.sync.flood_resident_hbm_bytes) "
+        "against P2P_HBM_BUDGET_GB (default 10 on TPU, unlimited "
+        "elsewhere): the full 4096-share pass at 1M nodes models ~12.6 GB "
+        "and crashed the 16 GB v5e worker (2026-07-31); 2048-share "
+        "passes model ~8.8 GB. Chunks below 4096 shares underfill the "
+        "TPU's 128-lane tile (slower gather per byte), so auto halves as "
+        "little as possible.",
     )
     ap.add_argument(
         "--block", type=int, default=8,
@@ -152,7 +157,45 @@ def main() -> None:
 
     rng = np.random.default_rng(args.seed)
     origins = rng.integers(0, graph.n, args.shares).astype(np.int32)
-    chunk = max(32, min(args.chunk, args.shares)) if args.chunk else args.shares
+    # pad: the explicit chunk_size handed to run_flood_coverage (None =
+    # the engine's default MIN_CHUNK_SHARES lane pad); chunk: the origin
+    # slice per pass. pad may exceed chunk (a 64-share pass padded to the
+    # widest W that fits the budget).
+    if args.chunk:
+        chunk = max(32, min(args.chunk, args.shares))
+        pad = chunk
+    else:
+        # Auto: fit the resident-HBM model into the device budget. Only
+        # the single-chip TPU path is budgeted by default — the host has
+        # RAM to spare and the mesh path divides rows across chips. None
+        # = the default pad already fits (or budgeting is off): stage
+        # exactly what the engine always staged.
+        from p2p_gossip_tpu.engine.sync import (
+            MIN_CHUNK_SHARES, auto_chunk_shares, flood_resident_hbm_bytes,
+        )
+        from p2p_gossip_tpu.ops.bitmask import num_words
+
+        on_tpu = devices[0].platform == "tpu" and mesh is None
+        # Mesh mode ignores the budget entirely (even an exported
+        # P2P_HBM_BUDGET_GB): the sharded engine pads every pass to its
+        # own chunk default, so a pad computed here would slice origins
+        # and log a staged shape that never actually changes — per-chip
+        # relief on the mesh comes from the node axis, not share width.
+        budget = 0.0 if mesh is not None else float(
+            os.environ.get("P2P_HBM_BUDGET_GB", "10" if on_tpu else "0")
+        ) * 1e9
+        pad = auto_chunk_shares(graph.degree, args.shares, args.block, budget)
+        chunk = args.shares if pad is None else min(pad, args.shares)
+        if pad is not None:
+            default_w = num_words(max(args.shares, MIN_CHUNK_SHARES))
+            log(
+                f"auto-chunk: default pad models "
+                f"{flood_resident_hbm_bytes(graph.degree, default_w, args.block) / 1e9:.1f} GB "
+                f"resident > {budget / 1e9:.1f} GB budget; padding to "
+                f"{pad} shares "
+                f"({flood_resident_hbm_bytes(graph.degree, num_words(pad), args.block) / 1e9:.1f} GB)"
+                + (f", {chunk} origins per pass" if chunk < args.shares else "")
+            )
 
     def flood_all():
         """Shares are independent: chunked passes, counters additive."""
@@ -171,7 +214,7 @@ def main() -> None:
             else:
                 stats, cov = run_flood_coverage(
                     graph, origins[lo : lo + chunk], args.horizon,
-                    device_graph=dg, block=args.block,
+                    device_graph=dg, block=args.block, chunk_size=pad,
                 )
             processed += stats.totals()["processed"]
             covs.append(cov)

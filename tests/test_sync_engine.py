@@ -219,3 +219,51 @@ def test_serialization_delay_model_parity_and_math():
     ev = run_event_sim(g, sched, 500, ell_delays=d_big)
     sy = run_sync_sim(g, sched, 500, ell_delays=d_big, chunk_size=32)
     assert sy.equal_counts(ev)
+
+
+def test_flood_coverage_explicit_small_chunk_bitwise():
+    """An explicit chunk_size below MIN_CHUNK_SHARES is honored (W shrinks)
+    and changes nothing observable — the HBM-relief path the 1M north star
+    uses (scale_1m.py auto-chunk) must be bitwise-identical to the padded
+    default, not merely statistically equivalent."""
+    g = pg.erdos_renyi(96, 0.06, seed=11)
+    origins = [0, 31, 44, 90]
+    ref_stats, ref_cov = run_flood_coverage(g, origins, 48)
+    small_stats, small_cov = run_flood_coverage(
+        g, origins, 48, chunk_size=64
+    )
+    assert np.array_equal(ref_cov, small_cov)
+    for f in ("generated", "received", "forwarded", "sent", "processed"):
+        assert np.array_equal(
+            getattr(ref_stats, f), getattr(small_stats, f)
+        ), f
+    small_stats.check_conservation()
+
+
+def test_resident_hbm_model_and_auto_chunk():
+    from p2p_gossip_tpu.engine.sync import (
+        auto_chunk_shares,
+        flood_resident_hbm_bytes,
+    )
+
+    # The north-star shape the model exists for: 1M nodes, mean degree
+    # ~1000, block 8. W=128 (the 4096-share pass that crashed the 16 GB
+    # v5e worker) must model over 12 GB; W=64 must model under 10 GB.
+    degree = np.full(1_000_000, 1000, dtype=np.int64)
+    full = flood_resident_hbm_bytes(degree, w=128, block=8)
+    half = flood_resident_hbm_bytes(degree, w=64, block=8)
+    assert full > 12e9
+    assert half < 10e9
+    assert half < full  # monotone in W
+
+    # Auto-chunk: None = stage the engine's default pad (budget disabled,
+    # or the default already fits); the 10 GB device budget halves the
+    # default pad once to 2048 — including for a 64-share request, whose
+    # DEFAULT pad is the same MIN_CHUNK_SHARES W=128 that crashed; a
+    # budget below the fixed ELL term floors at min_chunk instead of
+    # looping forever.
+    assert auto_chunk_shares(degree, 4096, 8, 0) is None
+    assert auto_chunk_shares(degree, 4096, 8, 100e9) is None
+    assert auto_chunk_shares(degree, 4096, 8, 10e9) == 2048
+    assert auto_chunk_shares(degree, 64, 8, 10e9) == 2048
+    assert auto_chunk_shares(degree, 4096, 8, 1e9, min_chunk=512) == 512
