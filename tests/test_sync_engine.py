@@ -267,3 +267,24 @@ def test_resident_hbm_model_and_auto_chunk():
     assert auto_chunk_shares(degree, 4096, 8, 10e9) == 2048
     assert auto_chunk_shares(degree, 64, 8, 10e9) == 2048
     assert auto_chunk_shares(degree, 4096, 8, 1e9, min_chunk=512) == 512
+
+
+@pytest.mark.parametrize(
+    "seed", range(int(__import__("os").environ.get("P2P_FUZZ_SEEDS", "4")))
+)
+def test_flood_coverage_chunk_pad_fuzz(seed):
+    """Randomized pad widths through the explicit-chunk_size path must stay
+    bitwise-equal to the default MIN_CHUNK_SHARES pad — the guard for the
+    HBM-relief staging scale_1m.py picks on the chip."""
+    rng = np.random.default_rng(seed + 900)
+    n = int(rng.integers(40, 160))
+    g = pg.erdos_renyi(n, 0.08, seed=seed)
+    s = int(rng.integers(1, 9))
+    origins = rng.integers(0, n, s).astype(np.int32)
+    pad = int(rng.choice([32, 64, 96, 128, 256]))
+    horizon = int(rng.integers(16, 48))
+    ref_stats, ref_cov = run_flood_coverage(g, origins, horizon)
+    st, cv = run_flood_coverage(g, origins, horizon, chunk_size=pad)
+    assert np.array_equal(ref_cov, cv), f"pad={pad}"
+    for f in ("generated", "received", "forwarded", "sent", "processed"):
+        assert np.array_equal(getattr(ref_stats, f), getattr(st, f)), f
