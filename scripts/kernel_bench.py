@@ -171,18 +171,21 @@ def main():
         dg = DeviceGraph.build(g, bucketed=True)
         w = args.words
         hist = rand_bits(2 * g.n, w).reshape(2, g.n, w)
-        # 128 rides along to test whether the round-1 sweep (which chose
-        # 64 from {8,16,32,64}) stopped short of the optimum.
-        for blk in (8, 32, 64, 128):
+        edges = int(np.asarray(dg.degree).sum())
+
+        def make_gather(blk):
             def gather(h):
                 arr = propagate_bucketed(
                     h[0][None], jnp.int32(1), dg.buckets, n_out=g.n,
                     ring_size=1, uniform_delay=0, block=blk,
                 )
                 return h ^ arr[None]
+            return gather
 
-            t = chain_time(gather, hist, max(args.iters // 2, 5))
-            edges = int(np.asarray(dg.degree).sum())
+        # 128 rides along to test whether the round-1 sweep (which chose
+        # 64 from {8,16,32,64}) stopped short of the optimum.
+        for blk in (8, 32, 64, 128):
+            t = chain_time(make_gather(blk), hist, max(args.iters // 2, 5))
             log(f"gather block={blk}: {t*1e3:.2f} ms/tick")
             emit(
                 kernel="gather_or_xla", rows=g.n, words=w, block=blk,
@@ -190,7 +193,6 @@ def main():
                 gathered_gb=round(edges * w * 4 / 1e9, 2),
                 achieved_gbps=round(edges * w * 4 / t / 1e9, 1),
             )
-        edges = int(np.asarray(dg.degree).sum())
         # Why no Pallas gather: a per-edge DMA formulation issues one
         # descriptor per (edge, W-word row); at ~1 us/descriptor issue+
         # latency that alone exceeds the XLA gather's whole-tick time by
@@ -210,6 +212,25 @@ def main():
                 f"{edges} descriptors x ~1us >> XLA gather tick; " + vmem_note
             ),
         )
+
+        # Word-width sweep at the tuned block: measures the lane-underfill
+        # penalty the MIN_CHUNK_SHARES comment quotes (~15x worse bytes/s
+        # at 32 words vs 128, round-1 measurement). The resident-HBM
+        # auto-chunk (scale_1m.py) halves the pad to 64 words at the 1M
+        # shape, so the 64-vs-128 ratio is exactly the bandwidth price of
+        # fitting — worth a measured row, not a two-generations-old quote.
+        # All four widths are emitted (the default width repeats its
+        # block-sweep measurement) so this table is self-contained.
+        for ww in (32, 64, 128, 256):
+            hist_w = rand_bits(2 * g.n, ww).reshape(2, g.n, ww)
+            t = chain_time(make_gather(64), hist_w, max(args.iters // 2, 5))
+            log(f"gather words={ww}: {t*1e3:.2f} ms/tick")
+            emit(
+                kernel="gather_or_xla_wsweep", rows=g.n, words=ww, block=64,
+                ms_per_tick=round(t * 1e3, 3),
+                gathered_gb=round(edges * ww * 4 / 1e9, 2),
+                achieved_gbps=round(edges * ww * 4 / t / 1e9, 1),
+            )
 
 
 def _time_cov(fn, seen, iters):

@@ -233,9 +233,12 @@ def stage_specs(args) -> dict:
             "budget": args.stage_budget or 1800,
         },
         "kernel": {
+            # 2400s: the gather section now also runs the word-width
+            # sweep (4 extra compile+measure cycles after the block
+            # sweep).
             "argv": kb + ["--rows", "100000"],
             "env": sweep_env,
-            "budget": args.stage_budget or 1800,
+            "budget": args.stage_budget or 2400,
         },
         "sweep250": {
             # No --skip-gather here: the kernel stage (already banked)
@@ -243,12 +246,16 @@ def stage_specs(args) -> dict:
             # kernel_bench, so this stage carries the open question of
             # whether the round-1 block sweep stopped short of the
             # optimum. The gather runs at min(rows, 100K) = the bench
-            # shape either way. Budget matches the kernel stage's: the
-            # gather section runs LAST in kernel_bench, and sweep250
-            # already timed out once at 1500s before reaching it.
+            # shape either way. The gather section runs LAST in
+            # kernel_bench, and sweep250 already timed out once at
+            # 1500s before reaching it.
+            # 2400s: the gather section now also runs the word-width
+            # sweep (4 extra compile+measure cycles) after the block
+            # sweep, and this stage once timed out at 1500s before
+            # reaching the gather at all.
             "argv": kb + ["--rows", "250000"],
             "env": sweep_env,
-            "budget": args.stage_budget or 1800,
+            "budget": args.stage_budget or 2400,
         },
         "sweep500": {
             "argv": kb + ["--rows", "500000", "--skip-gather"],

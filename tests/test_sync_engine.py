@@ -4,6 +4,8 @@ This is the "NS-3 stats parity" axis: same topology + schedule + integer
 delays must give identical per-node counters on both engines.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -270,7 +272,7 @@ def test_resident_hbm_model_and_auto_chunk():
 
 
 @pytest.mark.parametrize(
-    "seed", range(int(__import__("os").environ.get("P2P_FUZZ_SEEDS", "4")))
+    "seed", range(int(os.environ.get("P2P_FUZZ_SEEDS", "4")))
 )
 def test_flood_coverage_chunk_pad_fuzz(seed):
     """Randomized pad widths through the explicit-chunk_size path must stay
