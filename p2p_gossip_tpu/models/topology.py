@@ -469,3 +469,55 @@ def load_or_build_graph_cache(
     if cache:
         save_graph_cache(cache, graph, fp=fp)
     return graph
+
+
+def rcm_order(graph: Graph) -> np.ndarray:
+    """Reverse Cuthill–McKee node ordering: ``order[new_id] = old_id``.
+
+    A gather-locality lever, not a correctness feature: the tick engine's
+    hot op gathers neighbors' frontier rows by node id
+    (`ops/ell.py propagate_bucketed`), so renumbering nodes to cluster
+    neighborhoods turns random HBM row reads into nearer ones. Gains are
+    topology-dependent — lattices/small-world graphs reorder well, while
+    the ER benchmark graph is an expander whose bandwidth RCM provably
+    cannot reduce much — which is why this ships as a measurement
+    candidate (`kernel_bench.py` A/B row) rather than a default.
+    Gossip dynamics are label-invariant, so results are bitwise-equal
+    after unrelabeling (tested in tests/test_topology.py)."""
+    from scipy.sparse import csr_matrix
+    from scipy.sparse.csgraph import reverse_cuthill_mckee
+
+    m = csr_matrix(
+        (np.ones(graph.indices.shape[0], dtype=np.int8), graph.indices,
+         graph.indptr),
+        shape=(graph.n, graph.n),
+    )
+    return np.asarray(reverse_cuthill_mckee(m, symmetric_mode=True),
+                      dtype=np.int64)
+
+
+def relabel_graph(graph: Graph, order: np.ndarray) -> tuple[Graph, np.ndarray]:
+    """Apply a node renumbering: ``order[new_id] = old_id``.
+
+    Returns ``(relabeled, inv)`` where ``inv[old_id] = new_id``. Per-node
+    result arrays computed on the relabeled graph map back to original
+    ids as ``arr_new[inv]`` (verified bitwise for the flood engines in
+    tests/test_topology.py)."""
+    order = np.asarray(order, dtype=np.int64)
+    assert order.shape == (graph.n,)
+    inv = np.empty(graph.n, dtype=np.int64)
+    inv[order] = np.arange(graph.n, dtype=np.int64)
+    deg = graph.degree.astype(np.int64)[order]
+    indptr = np.zeros(graph.n + 1, dtype=np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    # Neighbor lists of row new_i are old row order[new_i]'s neighbors,
+    # renumbered, and re-sorted to keep the CSR per-row sort invariant.
+    gather_idx = np.repeat(graph.indptr[:-1][order], deg) + (
+        np.arange(indptr[-1], dtype=np.int64)
+        - np.repeat(indptr[:-1], deg)
+    )
+    indices = inv[graph.indices[gather_idx]].astype(np.int32)
+    rows = np.repeat(np.arange(graph.n, dtype=np.int64), deg)
+    indices = indices[np.lexsort((indices, rows))]
+    relabeled = Graph(n=graph.n, indptr=indptr, indices=indices)
+    return relabeled, inv

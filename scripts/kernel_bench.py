@@ -173,10 +173,10 @@ def main():
         hist = rand_bits(2 * g.n, w).reshape(2, g.n, w)
         edges = int(np.asarray(dg.degree).sum())
 
-        def make_gather(blk):
+        def make_gather(blk, dg_=dg, n_out=g.n):
             def gather(h):
                 arr = propagate_bucketed(
-                    h[0][None], jnp.int32(1), dg.buckets, n_out=g.n,
+                    h[0][None], jnp.int32(1), dg_.buckets, n_out=n_out,
                     ring_size=1, uniform_delay=0, block=blk,
                 )
                 return h ^ arr[None]
@@ -230,6 +230,35 @@ def main():
                 ms_per_tick=round(t * 1e3, 3),
                 gathered_gb=round(edges * ww * 4 / 1e9, 2),
                 achieved_gbps=round(edges * ww * 4 / t / 1e9, 1),
+            )
+
+        # RCM-relabeled gather: does clustering neighborhoods in node-id
+        # space (= HBM address space for the frontier rows) buy gather
+        # bandwidth? Same edges, same degree multiset, bitwise-equal
+        # dynamics (tests/test_topology.py) — only the id layout differs.
+        # On this ER expander RCM cannot reduce bandwidth much in theory;
+        # this row measures what locality is actually worth on the chip
+        # before investing in reorder-aware staging.
+        try:
+            from p2p_gossip_tpu.models.topology import (
+                rcm_order, relabel_graph,
+            )
+
+            rg, _inv = relabel_graph(g, rcm_order(g))
+        except ImportError as e:  # rcm_order needs scipy (optional dep)
+            emit(kernel="gather_or_xla_rcm", rows=g.n,
+                 note=f"skipped: {e}")
+        else:
+            dg_r = DeviceGraph.build(rg, bucketed=True)
+            t = chain_time(
+                make_gather(64, dg_r, rg.n), hist, max(args.iters // 2, 5)
+            )
+            log(f"gather rcm block=64: {t*1e3:.2f} ms/tick")
+            emit(
+                kernel="gather_or_xla_rcm", rows=g.n, words=w, block=64,
+                ms_per_tick=round(t * 1e3, 3),
+                gathered_gb=round(edges * w * 4 / 1e9, 2),
+                achieved_gbps=round(edges * w * 4 / t / 1e9, 1),
             )
 
 

@@ -233,12 +233,13 @@ def stage_specs(args) -> dict:
             "budget": args.stage_budget or 1800,
         },
         "kernel": {
-            # 2400s: the gather section now also runs the word-width
-            # sweep (4 extra compile+measure cycles after the block
+            # 2700s: the gather section now also runs the word-width
+            # sweep and the RCM-relabeled row (5 extra compile+measure
+            # cycles plus a host-side RCM + restaging after the block
             # sweep).
             "argv": kb + ["--rows", "100000"],
             "env": sweep_env,
-            "budget": args.stage_budget or 2400,
+            "budget": args.stage_budget or 2700,
         },
         "sweep250": {
             # No --skip-gather here: the kernel stage (already banked)
@@ -246,16 +247,14 @@ def stage_specs(args) -> dict:
             # kernel_bench, so this stage carries the open question of
             # whether the round-1 block sweep stopped short of the
             # optimum. The gather runs at min(rows, 100K) = the bench
-            # shape either way. The gather section runs LAST in
-            # kernel_bench, and sweep250 already timed out once at
-            # 1500s before reaching it.
-            # 2400s: the gather section now also runs the word-width
-            # sweep (4 extra compile+measure cycles) after the block
-            # sweep, and this stage once timed out at 1500s before
-            # reaching the gather at all.
+            # shape either way. 2700s: the gather section runs LAST in
+            # kernel_bench (this stage once timed out at 1500s before
+            # reaching it) and now also includes the word-width sweep
+            # and the RCM-relabeled row — 5 extra compile+measure cycles
+            # plus a host-side RCM + restaging.
             "argv": kb + ["--rows", "250000"],
             "env": sweep_env,
-            "budget": args.stage_budget or 2400,
+            "budget": args.stage_budget or 2700,
         },
         "sweep500": {
             "argv": kb + ["--rows", "500000", "--skip-gather"],
