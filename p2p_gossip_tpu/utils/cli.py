@@ -182,7 +182,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="Periodic stats interval in seconds",
     )
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--chunkSize", type=int, default=4096)
+    p.add_argument(
+        "--chunkSize", type=int, default=4096,
+        help="Shares per device pass (tpu/sharded backends). Values below "
+        "4096 shrink every (N, W) device buffer proportionally — the "
+        "memory-relief lever for huge N (see "
+        "engine.sync.flood_resident_hbm_bytes for the fit arithmetic) at "
+        "the price of underfilled 128-lane tiles.",
+    )
     p.add_argument(
         "--degreeBlock", type=int, default=0,
         help="Degree-block for the gather-OR scan (tpu/sharded backends; "
