@@ -82,6 +82,27 @@ def test_scale_1m_cpu_flag_runs_and_labels_metric():
     assert "waiting up to" not in r.stderr
 
 
+def test_scale_1m_auto_chunk_budget():
+    """A forced P2P_HBM_BUDGET_GB must engage the resident-HBM auto-chunk
+    (stderr announces the chosen pad) and still complete with full
+    coverage — the path the on-chip 1M ladder depends on."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["P2P_HBM_BUDGET_GB"] = "0.0012"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "scale_1m.py"),
+         "--cpu", "--nodes", "2000", "--prob", "0.01", "--shares", "2048",
+         "--horizon", "32", "--block", "8"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=420,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "auto-chunk:" in r.stderr
+    row = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "[cpu]" in row["metric"]
+    assert "full coverage: True" in r.stderr
+
+
 def test_mesh_rehearsal_cache_roundtrip(tmp_path):
     """--cache writes the graph with scale_1m.py's fingerprint scheme on
     the first run and loads it on the second (the 1M rehearsal reuses the
