@@ -14,6 +14,8 @@ so the only untested step to a physical v5e-8 is the hardware itself.
 Emits one JSON row per (N, ring_mode) on stdout; diagnostics on stderr.
 Usage: python scripts/mesh_rehearsal.py [--nodes 100000] [--prob 0.001]
        [--shares 64] [--devices 8] [--skip-parity]
+       [--protocol flood|pushpull|pull|pushk]   # partnered legs rehearse
+       BASELINE config 5's anti-entropy on the same mesh/ring machinery
 """
 
 import argparse
@@ -44,9 +46,22 @@ def main() -> int:
         help="lognormal delay cap (distinct delay values L <= cap)",
     )
     ap.add_argument(
+        "--protocol", choices=("flood", "pushpull", "pull", "pushk"),
+        default="flood",
+        help="which engine leg to rehearse: flood (config 3/5's delivery "
+        "mechanics) or a partnered protocol (pushpull = BASELINE config "
+        "5's anti-entropy leg) — partnered runs check bitwise equality "
+        "BETWEEN the two ring layouts (always) and vs the single-device "
+        "engine (unless --skip-parity)",
+    )
+    ap.add_argument("--fanout", type=int, default=3,
+                    help="k for --protocol pushk")
+    ap.add_argument(
         "--skip-parity", action="store_true",
         help="skip the single-device parity run (halves the wall time); "
-        "counter conservation is still checked on the sharded run",
+        "flood runs still check counter conservation, and every run "
+        "(flood or partnered) checks the two ring layouts against each "
+        "other bitwise",
     )
     ap.add_argument(
         "--cache", type=str, default="",
@@ -114,27 +129,71 @@ def main() -> int:
     rng = np.random.default_rng(args.seed)
     origins = rng.integers(0, graph.n, args.shares).astype(np.int32)
 
+    # One driver per leg, same (stats, coverage) contract, so the ring
+    # loop below treats flood and partnered protocols uniformly.
+    if args.protocol == "flood":
+        def run_single():
+            return run_flood_coverage(
+                graph, origins, args.horizon, ell_delays=delays,
+                block=args.block,
+            )
+
+        def run_mesh(ring_mode):
+            return run_sharded_flood_coverage(
+                graph, origins, args.horizon, mesh, ell_delays=delays,
+                block=args.block, ring_mode=ring_mode,
+            )
+    else:
+        from p2p_gossip_tpu.models.protocols import (
+            run_pushk_sim, run_pushpull_sim,
+        )
+        from p2p_gossip_tpu.parallel.protocols_sharded import (
+            run_sharded_partnered_sim,
+        )
+
+        sched = pg.Schedule(
+            graph.n, origins, np.zeros(args.shares, dtype=np.int32)
+        )
+
+        def run_single():
+            if args.protocol == "pushk":
+                return run_pushk_sim(
+                    graph, sched, args.horizon, fanout=args.fanout,
+                    ell_delays=delays, seed=args.seed, record_coverage=True,
+                )
+            return run_pushpull_sim(
+                graph, sched, args.horizon, ell_delays=delays,
+                seed=args.seed, record_coverage=True, mode=args.protocol,
+            )
+
+        def run_mesh(ring_mode):
+            return run_sharded_partnered_sim(
+                graph, sched, args.horizon, mesh, protocol=args.protocol,
+                fanout=args.fanout, ell_delays=delays, seed=args.seed,
+                record_coverage=True, ring_mode=ring_mode,
+            )
+
     cov_single = None
     if not args.skip_parity:
         t0 = time.perf_counter()
-        stats_1, cov_single = run_flood_coverage(
-            graph, origins, args.horizon, ell_delays=delays, block=args.block,
-        )
+        stats_1, cov_single = run_single()
         log(f"single-device run: {time.perf_counter() - t0:.1f}s")
 
+    mesh_runs = []
     for ring_mode in ("replicated", "sharded"):
         t0 = time.perf_counter()
-        stats_m, cov_m = run_sharded_flood_coverage(
-            graph, origins, args.horizon, mesh, ell_delays=delays,
-            block=args.block, ring_mode=ring_mode,
-        )
+        stats_m, cov_m = run_mesh(ring_mode)
         wall = time.perf_counter() - t0
         ring = stats_m.extra["ring"]
-        # Conservation holds whether or not the parity leg ran — at N=1M
-        # the single-device comparison is prohibitive on the host, but
-        # received==forwarded / sent==(gen+fwd)*degree still certify the
-        # sharded counters.
-        stats_m.check_conservation()
+        if args.protocol == "flood":
+            # Conservation holds whether or not the parity leg ran — at
+            # N=1M the single-device comparison is prohibitive on the
+            # host, but received==forwarded / sent==(gen+fwd)*degree
+            # still certify the sharded counters. (Partnered protocols
+            # have different counter laws; their always-on check is the
+            # cross-ring-mode bitwise equality below.)
+            stats_m.check_conservation()
+        mesh_runs.append((ring_mode, stats_m, cov_m))
         parity = None
         if cov_single is not None:
             parity = bool(
@@ -143,7 +202,12 @@ def main() -> int:
             )
             assert parity, f"mesh diverges from single-device ({ring_mode})"
         row = {
-            "rehearsal": "sharded_flood_coverage",
+            # Historical label continuity: committed artifacts (e.g.
+            # docs/artifacts/mesh_1m.json) carry "sharded_flood_coverage".
+            "rehearsal": (
+                "sharded_flood_coverage" if args.protocol == "flood"
+                else f"sharded_{args.protocol}"
+            ),
             "nodes": graph.n,
             "edges": graph.num_edges,
             "devices": args.devices,
@@ -159,6 +223,14 @@ def main() -> int:
         log(f"{ring_mode}: ring {ring['bytes_per_chip']} B/chip, "
             f"wall {wall:.1f}s, parity {parity}")
         print(json.dumps(row), flush=True)
+
+    # The two ring layouts must agree with each other bitwise — a check
+    # that costs nothing (both already ran) and survives --skip-parity,
+    # so even 1M rehearsals certify layout-independence.
+    (_, st_r, cov_r), (_, st_s, cov_s) = mesh_runs
+    assert st_r.equal_counts(st_s), "ring layouts disagree on counters"
+    assert np.array_equal(cov_r, cov_s), "ring layouts disagree on coverage"
+    log("ring layouts bitwise-equal (counters + coverage)")
     return 0
 
 

@@ -130,6 +130,25 @@ def test_mesh_rehearsal_cache_roundtrip(tmp_path):
     ]
 
 
+def test_mesh_rehearsal_partnered_protocol():
+    """--protocol pushpull rehearses BASELINE config 5's anti-entropy leg:
+    both ring layouts, single-device parity, and the cross-layout bitwise
+    check all run on the partnered engine too."""
+    r = _run_script(
+        "mesh_rehearsal.py", "--nodes", "400", "--prob", "0.02",
+        "--shares", "4", "--horizon", "32", "--devices", "2",
+        "--protocol", "pushpull",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows = [json.loads(line) for line in r.stdout.strip().splitlines()]
+    assert {row["rehearsal"] for row in rows} == {"sharded_pushpull"}
+    assert {row["ring_mode"] for row in rows} == {"replicated", "sharded"}
+    for row in rows:
+        assert row["parity_vs_single_device"] is True
+        assert row["coverage_final_min"] == 400
+    assert "ring layouts bitwise-equal" in r.stderr
+
+
 def test_protocol_compare_cpu_flag():
     r = _run_script_cpu_flag(
         "protocol_compare.py", "--json", "--nodes", "200", "--prob", "0.03",
