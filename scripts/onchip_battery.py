@@ -20,33 +20,35 @@ Stages (value-first within safety bands — see the note after the list):
   scale1m   — scale_1m.py --shares 64 --chunk 64 -> the 1M ER on-chip
                line at the minimal resident footprint (pad W=2, ~5.2 GB
                modeled = essentially the bare ELL). The full-config
-               attempt lives in scale1m_full, LAST, because its W=128
-               one-pass shape crashed the TPU worker on 2026-07-31
-               (window #3) and a crash wedges the tunnel for every
-               stage after it.
+               attempt lives in scale1m_full, ordered behind every
+               proven-safe stage, because its W=128 one-pass shape
+               crashed the TPU worker on 2026-07-31 (window #3) and a
+               crash wedges the tunnel for every stage after it.
   scale1m_ba — scale_1m.py --topology ba     -> BASELINE config 4 (1M
                scale-free) JSON line
-  sweep250  — kernel_bench.py --rows 250000  -> coverage A/B row sweep.
-  sweep500  — kernel_bench.py --rows 500000     Near-last on purpose:
-  sweep1m   — kernel_bench.py --rows 1000000    since the round-4
-               bake-off gated the coverage kernel at its measured 100K
-               crossover, no product path runs it at these sizes — the
-               sweep is for-the-record characterization, worth less than
-               any stage above it. (It was ordered before the 1M stages
-               when it doubled as the 1M-crash bisection of a
-               then-enabled kernel; with the kernel off at 1M, a scale1m
-               crash no longer implicates it.)
+  sweep250  — kernel_bench.py --rows 250000  -> coverage A/B at 250K
+               (already survived on-chip in window #2) plus the gather
+               block-128 / word-width / RCM rows — real tuning value.
   scale1m_full — scale_1m.py at the full default config (ER 1M, 4096
-               shares). Dead last: this invocation crashed the TPU
-               worker in window #3 (battery_latest.jsonl stage scale1m,
-               rc=1, JaxRuntimeError "TPU worker process crashed", after
-               graph build + staging succeeded — the resident-HBM model
-               puts the one-pass W=128 footprint at ~12.6 GB on a 16 GB
-               chip; Pallas is gated off at 1M, so it is not implicated).
-               scale_1m.py now auto-chunks against P2P_HBM_BUDGET_GB
-               (4096 shares -> 2x 2048-share passes, ~8.8 GB modeled),
-               which should make this stage survivable — but it stays
-               last until a window proves that.
+               shares). After sweep250, before the big sweeps: this
+               invocation crashed the TPU worker in window #3
+               (battery_latest.jsonl stage scale1m, rc=1, JaxRuntimeError
+               "TPU worker process crashed", after graph build + staging
+               succeeded — the resident-HBM model puts the one-pass
+               W=128 footprint at ~12.6 GB on a 16 GB chip; Pallas is
+               gated off at 1M, so it is not implicated). scale_1m.py
+               now auto-chunks against P2P_HBM_BUDGET_GB (4096 shares ->
+               2x 2048-share passes, ~8.8 GB modeled), which should make
+               it survivable; it still runs after every proven-safe
+               stage.
+  sweep500  — kernel_bench.py --rows 500000     Dead last on purpose:
+  sweep1m   — kernel_bench.py --rows 1000000    these deliberately run
+               the Pallas coverage kernel at row counts it has NEVER
+               executed on hardware (the original round-2 crash
+               suspect), and since the bake-off gated the kernel at its
+               measured 100K crossover, no product path runs it at
+               these sizes — for-the-record characterization with real
+               crash risk, worth less than everything above it.
 
 Observed tunnel windows are ~50 min; the order above is value-first
 within safety bands so a short window always banks the most important
@@ -85,8 +87,8 @@ ART_DIR = os.path.join(REPO, "docs", "artifacts")
 
 STAGE_ORDER = (
     "bench", "protocols", "kernel", "bench_rep2", "bench_rep3",
-    "scale1m", "scale1m_ba", "sweep250", "sweep500", "sweep1m",
-    "scale1m_full",
+    "scale1m", "scale1m_ba", "sweep250", "scale1m_full",
+    "sweep500", "sweep1m",
 )
 
 
@@ -273,7 +275,8 @@ def stage_specs(args) -> dict:
             # can occupy at all. Slow per gathered byte (sub-lane W) but
             # the job is 64 origins; what it buys is the first-ever 1M
             # on-chip completion at the lowest possible crash risk. The
-            # auto-chunked ~8.8 GB shape is scale1m_full's job, last.
+            # auto-chunked ~8.8 GB shape is scale1m_full's job, ordered
+            # behind every proven-safe stage.
             "argv": [
                 py, os.path.join(SCRIPTS, "scale_1m.py"),
                 "--shares", "64", "--chunk", "64",
