@@ -36,6 +36,12 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=100_000)
     ap.add_argument("--prob", type=float, default=0.001)
+    ap.add_argument(
+        "--topology", choices=("er", "ba"), default="er",
+        help="er = config 3/5's Erdos-Renyi; ba = config 4's 1M "
+        "scale-free, node-sharded over the mesh",
+    )
+    ap.add_argument("--baM", type=int, default=3)
     ap.add_argument("--shares", type=int, default=64)
     ap.add_argument("--horizon", type=int, default=48)
     ap.add_argument("--devices", type=int, default=8)
@@ -56,6 +62,14 @@ def main() -> int:
     )
     ap.add_argument("--fanout", type=int, default=3,
                     help="k for --protocol pushk")
+    ap.add_argument(
+        "--chunkSize", type=int, default=0,
+        help="explicit share-pad width (0 = engine default 4096-share "
+        "lane pad). On the VIRTUAL mesh all shards live in one host "
+        "process, so the default W=128 pad multiplies every ring/frontier "
+        "buffer x8 in one RSS — 1M scale-free (dmax 4517, ~40 GB "
+        "full-width ELL) OOMs with it and needs e.g. --chunkSize 64",
+    )
     ap.add_argument(
         "--skip-parity", action="store_true",
         help="skip the single-device parity run (halves the wall time); "
@@ -105,6 +119,14 @@ def main() -> int:
     from p2p_gossip_tpu.models.topology import load_or_build_graph_cache
 
     def build():
+        if args.topology == "ba":
+            graph = native.native_barabasi_albert(
+                args.nodes, m=args.baM, seed=args.seed
+            )
+            if graph is None:
+                graph = pg.barabasi_albert(args.nodes, m=args.baM,
+                                           seed=args.seed)
+            return graph
         graph = native.native_erdos_renyi(
             args.nodes, args.prob, seed=args.seed
         )
@@ -114,8 +136,8 @@ def main() -> int:
 
     t0 = time.perf_counter()
     graph = load_or_build_graph_cache(
-        args.cache, topology="er", nodes=args.nodes, prob=args.prob,
-        ba_m=3, seed=args.seed, build=build, log=log,
+        args.cache, topology=args.topology, nodes=args.nodes,
+        prob=args.prob, ba_m=args.baM, seed=args.seed, build=build, log=log,
     )
     log(
         f"graph: N={graph.n} edges={graph.num_edges} dmax={graph.max_degree}"
@@ -125,6 +147,48 @@ def main() -> int:
         graph, mean_ticks=2.0, sigma=0.6, max_ticks=args.delay_max_ticks,
         seed=args.seed,
     )
+
+    if not args.chunkSize:
+        # Host-fit preflight: the virtual mesh concentrates every shard in
+        # ONE process, so the default 4096-share pad — deliberately
+        # faithful to config 5's real per-chip ring footprint — can
+        # exceed host RAM where 8 real chips would each hold 1/8th. The
+        # dominant terms: the sharded engine's FULL-WIDTH ELL staging
+        # (N x dmax x (4B idx + 4B delay + 1B mask), hub-sensitive: 1M BA
+        # at dmax 4517 is ~40 GB and OOM-killed the first attempt,
+        # docs/artifacts/mesh_ba_1m.log) plus one history ring per
+        # virtual device. Auto-shrink the pad only when the model
+        # exceeds available RAM, and say so loudly — a shrunk pad keeps
+        # every parity/coverage check but stops modeling the real
+        # config-5 ring bytes.
+        from p2p_gossip_tpu.ops.bitmask import num_words
+
+        avail = float(os.environ.get("P2P_HOST_BUDGET_GB", "0")) * 1e9
+        if not avail:
+            avail = 0.7 * os.sysconf("SC_AVPHYS_PAGES") * os.sysconf(
+                "SC_PAGE_SIZE"
+            )
+        fw_ell = graph.n * graph.max_degree * 9
+        ring_slots = args.delay_max_ticks + 1
+
+        def host_total(pad):
+            row = num_words(max(args.shares, pad)) * 4
+            rings = args.devices * ring_slots * graph.n * row
+            return fw_ell + rings + 6 * graph.n * row
+
+        pad = 4096
+        while pad > 32 and host_total(pad) > avail:
+            pad //= 2
+        if pad < 4096:
+            args.chunkSize = pad
+            log(
+                f"host-fit: default 4096-share pad models "
+                f"{host_total(4096) / 1e9:.1f} GB on this host "
+                f"(> {avail / 1e9:.1f} GB available); shrinking pad to "
+                f"{pad} shares ({host_total(pad) / 1e9:.1f} GB). Parity "
+                "checks are unaffected; ring-bytes rows no longer model "
+                "the real config-5 footprint."
+            )
     n_delay_values = len(np.unique(delays[graph.ell()[1]]))
     rng = np.random.default_rng(args.seed)
     origins = rng.integers(0, graph.n, args.shares).astype(np.int32)
@@ -136,12 +200,14 @@ def main() -> int:
             return run_flood_coverage(
                 graph, origins, args.horizon, ell_delays=delays,
                 block=args.block,
+                chunk_size=args.chunkSize or None,
             )
 
         def run_mesh(ring_mode):
             return run_sharded_flood_coverage(
                 graph, origins, args.horizon, mesh, ell_delays=delays,
                 block=args.block, ring_mode=ring_mode,
+                **({"chunk_size": args.chunkSize} if args.chunkSize else {}),
             )
     else:
         from p2p_gossip_tpu.models.protocols import (
@@ -155,22 +221,28 @@ def main() -> int:
             graph.n, origins, np.zeros(args.shares, dtype=np.int32)
         )
 
+        chunk_kw = (
+            {"chunk_size": args.chunkSize} if args.chunkSize else {}
+        )
+
         def run_single():
             if args.protocol == "pushk":
                 return run_pushk_sim(
                     graph, sched, args.horizon, fanout=args.fanout,
                     ell_delays=delays, seed=args.seed, record_coverage=True,
+                    **chunk_kw,
                 )
             return run_pushpull_sim(
                 graph, sched, args.horizon, ell_delays=delays,
                 seed=args.seed, record_coverage=True, mode=args.protocol,
+                **chunk_kw,
             )
 
         def run_mesh(ring_mode):
             return run_sharded_partnered_sim(
                 graph, sched, args.horizon, mesh, protocol=args.protocol,
                 fanout=args.fanout, ell_delays=delays, seed=args.seed,
-                record_coverage=True, ring_mode=ring_mode,
+                record_coverage=True, ring_mode=ring_mode, **chunk_kw,
             )
 
     cov_single = None
@@ -209,6 +281,7 @@ def main() -> int:
                 else f"sharded_{args.protocol}"
             ),
             "nodes": graph.n,
+            "topology": args.topology,
             "edges": graph.num_edges,
             "devices": args.devices,
             "shares": args.shares,

@@ -130,6 +130,27 @@ def test_mesh_rehearsal_cache_roundtrip(tmp_path):
     ]
 
 
+def test_mesh_rehearsal_ba_topology_and_chunk():
+    """--topology ba (config 4's scale-free mesh leg) and --chunkSize (the
+    virtual-mesh memory-relief pad) must run with parity and label the
+    rows; small pads shrink the ring accounting proportionally."""
+    r = _run_script(
+        "mesh_rehearsal.py", "--nodes", "500", "--topology", "ba",
+        "--baM", "3", "--shares", "8", "--horizon", "24",
+        "--devices", "2", "--chunkSize", "32",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows = [json.loads(line) for line in r.stdout.strip().splitlines()]
+    assert {row["topology"] for row in rows} == {"ba"}
+    for row in rows:
+        assert row["parity_vs_single_device"] is True
+        assert row["coverage_final_min"] == 500
+    # W=1 (32 shares) vs the default W=128 pad: ring accounting must
+    # reflect the small pad, not the 4096-share default.
+    repl = next(r2 for r2 in rows if r2["ring_mode"] == "replicated")
+    assert repl["ring_bytes_per_chip"] == repl["ring_slots"] * 500 * 1 * 4
+
+
 def test_mesh_rehearsal_partnered_protocol():
     """--protocol pushpull rehearses BASELINE config 5's anti-entropy leg:
     both ring layouts, single-device parity, and the cross-layout bitwise
