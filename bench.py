@@ -62,6 +62,12 @@ def _latest_onchip_bench_record() -> dict | None:
                         best = {
                             "artifact": os.path.relpath(path, repo),
                             "value": res.get("value"),
+                            # The on-chip config string rides along: the
+                            # fallback row's own metric names the REDUCED
+                            # CPU config, and without this label a reader
+                            # can mistake onchip_value for a measurement
+                            # of that config (round-4 verdict weak #5).
+                            "metric": metric,
                             "utc": rec.get("utc", ""),
                         }
             except Exception:
@@ -262,6 +268,7 @@ def main() -> None:
         onchip = _latest_onchip_bench_record()
         if onchip is not None:
             row["onchip_artifact"] = onchip["artifact"]
+            row["onchip_metric"] = onchip["metric"]
             row["onchip_value"] = onchip["value"]
             row["onchip_utc"] = onchip["utc"]
     print(json.dumps(row))

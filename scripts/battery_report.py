@@ -126,7 +126,7 @@ def main() -> int:
         print()
 
     kernel_rows = []
-    for stage in ("kernel", "sweep250", "sweep500", "sweep1m"):
+    for stage in ("kernel", "sweep250"):
         rec = by_stage.get(stage)
         if rec:
             for row in rec["results"]:
@@ -148,6 +148,45 @@ def main() -> int:
                 "rows", "block", "ms_per_tick", "gathered_gb",
                 "achieved_gbps",
             ]))
+            print()
+        wsweep = [
+            r for r in kernel_rows if r.get("kernel") == "gather_or_xla_wsweep"
+        ]
+        if wsweep:
+            print("## Gather-OR word-width sweep (block 64)\n")
+            print(md_table(wsweep, [
+                "rows", "words", "ms_per_tick", "gathered_gb",
+                "achieved_gbps",
+            ]))
+            print()
+        rcm = [r for r in kernel_rows if r.get("kernel") == "gather_or_xla_rcm"]
+        if rcm:
+            print("## Gather-OR with RCM-relabeled graph (block 64)\n")
+            print(md_table(rcm, [
+                "rows", "words", "ms_per_tick", "gathered_gb",
+                "achieved_gbps", "note",
+            ]))
+            print()
+
+    prof = by_stage.get("profile")
+    if prof and prof["results"]:
+        summaries = [
+            r for r in prof["results"] if r.get("kind") == "profile_summary"
+        ]
+        if summaries:
+            s = summaries[-1]
+            print("## Profiler calibration (measured vs modeled HBM)\n")
+            print(md_table([s], [
+                "bench_metric",
+                "tool", "op_rows", "ops_with_hbm_bw", "total_self_time_us",
+                "measured_hbm_bytes", "measured_hbm_gbps_over_self_time",
+                "modeled_achieved_gbps", "measured_over_modeled", "capture",
+            ]))
+            if s.get("error"):
+                print(f"\nparse error: `{s['error']}`" + (
+                    " (capture committed for offline re-parse)"
+                    if s.get("capture") else " (no capture committed)"
+                ))
             print()
 
     for stage, title in (

@@ -29,26 +29,30 @@ Stages (value-first within safety bands — see the note after the list):
   sweep250  — kernel_bench.py --rows 250000  -> coverage A/B at 250K
                (already survived on-chip in window #2) plus the gather
                block-128 / word-width / RCM rows — real tuning value.
+  profile   — profile_capture.py             -> profiled bench pass +
+               parsed XPlane trace: MEASURED HBM bytes vs the modeled
+               roofline (round-4 verdict item #4). Cheap (~one bench),
+               but jax.profiler through the tunnel is unvalidated, so
+               it sits after the proven-safe stages and before the one
+               stage that has actually crashed the worker.
   scale1m_full — scale_1m.py at the full default config (ER 1M, 4096
-               shares). After sweep250, before the big sweeps: this
-               invocation crashed the TPU worker in window #3
-               (battery_latest.jsonl stage scale1m, rc=1, JaxRuntimeError
-               "TPU worker process crashed", after graph build + staging
-               succeeded — the resident-HBM model puts the one-pass
-               W=128 footprint at ~12.6 GB on a 16 GB chip; Pallas is
-               gated off at 1M, so it is not implicated). scale_1m.py
-               now auto-chunks against P2P_HBM_BUDGET_GB (4096 shares ->
-               2x 2048-share passes, ~8.8 GB modeled), which should make
-               it survivable; it still runs after every proven-safe
-               stage.
-  sweep500  — kernel_bench.py --rows 500000     Dead last on purpose:
-  sweep1m   — kernel_bench.py --rows 1000000    these deliberately run
-               the Pallas coverage kernel at row counts it has NEVER
-               executed on hardware (the original round-2 crash
-               suspect), and since the bake-off gated the kernel at its
-               measured 100K crossover, no product path runs it at
-               these sizes — for-the-record characterization with real
-               crash risk, worth less than everything above it.
+               shares). Dead last on purpose: this invocation crashed
+               the TPU worker in window #3 (battery_latest.jsonl stage
+               scale1m, rc=1, JaxRuntimeError "TPU worker process
+               crashed", after graph build + staging succeeded — the
+               resident-HBM model puts the one-pass W=128 footprint at
+               ~12.6 GB on a 16 GB chip; Pallas is gated off at 1M, so
+               it is not implicated). scale_1m.py now auto-chunks
+               against P2P_HBM_BUDGET_GB (4096 shares -> 2x 2048-share
+               passes, ~8.8 GB modeled), which should make it
+               survivable; it still runs after every proven-safe stage.
+
+  (The round-4 sweep500/sweep1m stages — the Pallas coverage kernel at
+  500K/1M rows — are deleted: the bake-off measured the kernel LOSING
+  above its 100K crossover and production gates it off there
+  (ops/pallas_kernels.py), so those rows would characterize a path
+  nothing runs, at real worker-crash risk, in tunnel windows the 1M
+  ladder and the roofline rows need. Round-4 verdict weak item #4.)
 
 Observed tunnel windows are ~50 min; the order above is value-first
 within safety bands so a short window always banks the most important
@@ -87,8 +91,7 @@ ART_DIR = os.path.join(REPO, "docs", "artifacts")
 
 STAGE_ORDER = (
     "bench", "protocols", "kernel", "bench_rep2", "bench_rep3",
-    "scale1m", "scale1m_ba", "sweep250", "scale1m_full",
-    "sweep500", "sweep1m",
+    "scale1m", "scale1m_ba", "sweep250", "profile", "scale1m_full",
 )
 
 
@@ -162,15 +165,16 @@ def stage_specs(args) -> dict:
                 "env": cpu,
                 "budget": args.stage_budget or 600,
             },
-            "sweep500": {
-                "argv": kb_small + ["--skip-gather"],
+            "profile": {
+                # --art-dir follows the battery's own artifact dir so a
+                # smoke fire never drops a CPU capture into
+                # docs/artifacts as if it were chip evidence.
+                "argv": [
+                    py, os.path.join(SCRIPTS, "profile_capture.py"),
+                    "--smoke", "--art-dir", args.art_dir,
+                ],
                 "env": cpu,
-                "budget": args.stage_budget or 600,
-            },
-            "sweep1m": {
-                "argv": kb_small + ["--skip-gather"],
-                "env": cpu,
-                "budget": args.stage_budget or 600,
+                "budget": args.stage_budget or 900,
             },
             "scale1m": {
                 "argv": [
@@ -258,13 +262,14 @@ def stage_specs(args) -> dict:
             "env": sweep_env,
             "budget": args.stage_budget or 2700,
         },
-        "sweep500": {
-            "argv": kb + ["--rows", "500000", "--skip-gather"],
-            "env": sweep_env,
-            "budget": args.stage_budget or 1500,
-        },
-        "sweep1m": {
-            "argv": kb + ["--rows", "1000000", "--skip-gather"],
+        "profile": {
+            # One profiled bench pass + trace parse. --art-dir follows
+            # the battery's artifact dir (default docs/artifacts) so a
+            # redirected battery keeps its captures contained too.
+            "argv": [
+                py, os.path.join(SCRIPTS, "profile_capture.py"),
+                "--art-dir", args.art_dir,
+            ],
             "env": sweep_env,
             "budget": args.stage_budget or 1800,
         },

@@ -1,0 +1,286 @@
+"""One-shot profiled bench + trace parse (VERDICT round-4 item #4).
+
+Every perf claim in the repo inherits the error bar of the modeled
+roofline (`DeviceGraph.hbm_bytes_per_tick`, engine/sync.py): achieved
+GB/s figures are modeled-bytes / measured-wall. This script calibrates
+that model against the chip's own counters, once, on hardware:
+
+1. runs bench.py with its opt-in profiler capture enabled
+   (P2P_BENCH_PROFILE_DIR — the timed pass runs under
+   jax.profiler.trace and the JSON row is stamped "profiled");
+2. parses the captured XPlane trace with the xprof converter
+   (roofline_model + overview tools: per-HLO-op self time, measured
+   memory bandwidth, HBM bandwidth);
+3. emits the bench row (pass-through) plus a `profile_summary` JSON
+   line: total device time, measured HBM bytes (sum over ops of
+   hbm_bw x self_time), the bench's modeled bytes, and the calibration
+   factor measured/modeled;
+4. gzips the xplane.pb into docs/artifacts/ so the profile itself is a
+   committed artifact, not just a derived number.
+
+Every parse step is defensive: a trace the axon platform writes
+differently (tracing through the tunnel was unvalidated before this
+stage first ran) still yields the bench row, the committed capture, and
+a summary row carrying the parse error — evidence never goes to zero.
+
+Usage:
+  python scripts/profile_capture.py            # real chip via bench.py
+  python scripts/profile_capture.py --smoke    # CPU smoke (CI contract)
+"""
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from datetime import datetime, timezone
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART_DIR = os.path.join(REPO, "docs", "artifacts")
+
+
+def log(msg: str) -> None:
+    print(f"[profile] {msg}", file=sys.stderr, flush=True)
+
+
+def gviz_rows(tool_json: str | bytes) -> tuple[list[dict], dict]:
+    """Flatten one gviz DataTable JSON into [{col_id: value}] + props.
+
+    The converter emits either a bare table or a list of tables; the
+    first table carries the per-op rows for every tool used here.
+    """
+    obj = json.loads(
+        tool_json if isinstance(tool_json, str) else tool_json.decode()
+    )
+    tbl = obj[0] if isinstance(obj, list) else obj
+    cols = [c["id"] for c in tbl.get("cols", [])]
+    rows = []
+    for r in tbl.get("rows", []):
+        cells = [c.get("v") if isinstance(c, dict) else None for c in r["c"]]
+        rows.append(dict(zip(cols, cells)))
+    return rows, tbl.get("p", {})
+
+
+def fnum(x) -> float:
+    """gviz cells arrive as float, int, or formatted string — or None."""
+    if x is None:
+        return 0.0
+    try:
+        return float(str(x).replace(",", ""))
+    except ValueError:
+        return 0.0
+
+
+def summarize_trace(pb_path: str) -> dict:
+    """Aggregate measured op time + HBM bytes from one xplane.pb.
+
+    Bytes come from the roofline_model tool's per-op rows:
+    hbm_bw [GB/s] x total_self_time [us] = bytes x 1e-3. Ops with no
+    HBM figure (CPU traces; infeed) contribute zero — the summary
+    records how many ops carried a nonzero figure so a reader can tell
+    "measured 0 bytes" from "tool had no counters".
+    """
+    from xprof.convert import raw_to_tool_data as rtd
+
+    summary: dict = {"trace": os.path.basename(pb_path)}
+    rows, props = gviz_rows(
+        rtd.xspace_to_tool_data([pb_path], "roofline_model", {})[0]
+    )
+    # The tool emits aggregate rows (step="Total"/program) alongside
+    # per-op rows (rank > 0); only per-op rows sum without double count.
+    op_rows = [
+        r for r in rows
+        if fnum(r.get("rank")) > 0 and r.get("operation") != "IDLE"
+    ]
+    summary["tool"] = "roofline_model"
+    if not op_rows:
+        # CPU traces (and possibly the axon plugin's) leave the roofline
+        # table empty; hlo_stats carries the same self-time +
+        # hbm_bw/measured_memory_bw columns per HLO op.
+        rows, _ = gviz_rows(
+            rtd.xspace_to_tool_data([pb_path], "hlo_stats", {})[0]
+        )
+        for r in rows:  # hlo_stats names the op column differently —
+            r.setdefault("operation", r.get("hlo_op_name"))  # alias BEFORE
+        op_rows = [  # the IDLE filter, or IDLE rows slip through it
+            r for r in rows
+            if fnum(r.get("rank")) > 0 and r.get("operation") != "IDLE"
+        ]
+        summary["tool"] = "hlo_stats"
+    total_self_us = sum(fnum(r.get("total_self_time")) for r in op_rows)
+    hbm_bytes = sum(
+        fnum(r.get("hbm_bw")) * fnum(r.get("total_self_time")) * 1e3
+        for r in op_rows
+    )
+    measured_bytes = sum(
+        fnum(r.get("measured_memory_bw")) * fnum(r.get("total_self_time"))
+        * 1e3
+        for r in op_rows
+    )
+    summary.update(
+        op_rows=len(op_rows),
+        ops_with_hbm_bw=sum(1 for r in op_rows if fnum(r.get("hbm_bw")) > 0),
+        total_self_time_us=round(total_self_us, 1),
+        measured_hbm_bytes=round(hbm_bytes),
+        measured_mem_bytes=round(measured_bytes),
+        peak_hbm_bw_gbps=fnum(props.get("peak_hbm_bw")),
+        device_type=props.get("device_type", ""),
+        top_ops=[
+            {
+                "op": r.get("operation"),
+                "category": r.get("category"),
+                "self_us": fnum(r.get("total_self_time")),
+                "hbm_gbps": fnum(r.get("hbm_bw")),
+                "bound_by": r.get("bound_by"),
+            }
+            for r in sorted(
+                op_rows,
+                key=lambda r: -fnum(r.get("total_self_time")),
+            )[:10]
+        ],
+    )
+    return summary
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU smoke shapes (exercises capture+parse only)")
+    ap.add_argument("--art-dir", default=ART_DIR,
+                    help="where the gzipped capture + summary land")
+    ap.add_argument("--keep-trace-mb", type=float, default=64.0,
+                    help="skip committing captures gzipping above this")
+    args = ap.parse_args()
+
+    stamp = datetime.now(timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    trace_dir = tempfile.mkdtemp(prefix="p2p_profile_")
+    env = dict(os.environ)
+    env["P2P_BENCH_PROFILE_DIR"] = trace_dir
+    if args.smoke:
+        env["P2P_BENCH_SMOKE"] = "1"
+        # Forced, not setdefault: the operator shell usually exports
+        # JAX_PLATFORMS=axon, and a smoke run must never wait on the
+        # tunnel.
+        env["JAX_PLATFORMS"] = "cpu"
+
+    # bench.py owns the device wait / CPU fallback / JSON contract; this
+    # wrapper only adds the capture env and the parse. Pass stderr
+    # through so the battery record keeps bench's own diagnostics.
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, capture_output=True, text=True,
+    )
+    sys.stderr.write(proc.stderr)
+    bench_rows = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            bench_rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            log(f"non-JSON bench stdout: {line[:120]}")
+    for row in bench_rows:
+        print(json.dumps(row), flush=True)
+    if proc.returncode != 0:
+        log(f"bench.py rc={proc.returncode}; no trace to parse")
+        shutil.rmtree(trace_dir, ignore_errors=True)
+        return proc.returncode
+
+    summary: dict = {"kind": "profile_summary", "utc_stamp": stamp}
+    # The bench row's metric names the platform it actually ran on —
+    # carry it so the summary (and the battery report) self-describe
+    # CPU vs TPU, per the repo's labeling discipline.
+    bench_metric = bench_rows[0]["metric"] if bench_rows else ""
+    summary["bench_metric"] = bench_metric
+    cpu_fallback = not args.smoke and "CPU" in bench_metric
+    if cpu_fallback:
+        # A wedged tunnel turned the profiled pass into bench.py's
+        # reduced CPU config. That trace answers nothing about HBM: do
+        # NOT commit it as chip evidence, and exit nonzero so the
+        # battery records the stage not-ok and --skip-done re-fires it
+        # on the next window instead of latching a CPU number as the
+        # calibration (round-5 review finding).
+        summary["error"] = (
+            "bench fell back to CPU (tunnel down); no on-chip trace — "
+            "stage must re-fire"
+        )
+        log(summary["error"])
+        print(json.dumps(summary), flush=True)
+        shutil.rmtree(trace_dir, ignore_errors=True)
+        return 1
+    pbs = sorted(glob.glob(
+        os.path.join(trace_dir, "plugins", "profile", "*", "*.xplane.pb")
+    ))
+    if not pbs:
+        summary["error"] = "no xplane.pb produced under the profile dir"
+        print(json.dumps(summary), flush=True)
+        shutil.rmtree(trace_dir, ignore_errors=True)
+        return 1
+
+    pb = pbs[-1]
+    raw_mb = os.path.getsize(pb) / 1e6
+    os.makedirs(args.art_dir, exist_ok=True)
+    if raw_mb <= args.keep_trace_mb:
+        gz = os.path.join(args.art_dir, f"profile_{stamp}.xplane.pb.gz")
+        with open(pb, "rb") as fin, gzip.open(gz, "wb") as fout:
+            shutil.copyfileobj(fin, fout)
+        summary["capture"] = os.path.relpath(gz, REPO)
+        summary["capture_raw_mb"] = round(raw_mb, 1)
+        log(f"capture committed: {gz} ({raw_mb:.1f} MB raw)")
+    else:
+        summary["capture"] = None
+        summary["capture_raw_mb"] = round(raw_mb, 1)
+        log(f"capture too large to commit ({raw_mb:.1f} MB); parsed only")
+
+    try:
+        summary.update(summarize_trace(pb))
+    except Exception as e:  # parse failure must not lose the capture
+        summary["error"] = f"{type(e).__name__}: {e}"
+
+    # Calibration: bench's modeled bytes over the SAME timed pass =
+    # achieved_gbps x wall, and wall = ticks x (bytes_tick / ...); the
+    # row carries achieved_gbps + ticks, and value/ticks gives wall
+    # back: wall = processed/value. Recompute modeled bytes directly to
+    # avoid chaining roundings: modeled = achieved_gbps * 1e9 * wall.
+    for row in bench_rows:
+        if "achieved_gbps" in row and row.get("profiled"):
+            # wall back out of the rate: node-updates / (updates/s).
+            # processed isn't in the row; ticks x bytes/tick arrives via
+            # achieved_gbps = modeled_total / wall / 1e9, so modeled
+            # bytes need wall. Record the ratio instead using time from
+            # the trace: measured_bytes / (achieved_gbps * 1e9 *
+            # device_seconds) once both are on the same clock. Simpler
+            # and robust: report both rates and let the ratio of RATES
+            # calibrate — measured_hbm_bytes / total_self_time vs
+            # achieved_gbps are directly comparable bandwidths.
+            if summary.get("total_self_time_us", 0) > 0:
+                meas_gbps = (
+                    summary.get("measured_hbm_bytes", 0)
+                    / (summary["total_self_time_us"] * 1e-6) / 1e9
+                )
+                summary["measured_hbm_gbps_over_self_time"] = round(
+                    meas_gbps, 1
+                )
+                summary["modeled_achieved_gbps"] = row["achieved_gbps"]
+                if row["achieved_gbps"]:
+                    summary["measured_over_modeled"] = round(
+                        meas_gbps / row["achieved_gbps"], 3
+                    )
+            break
+
+    print(json.dumps(summary), flush=True)
+    summary_path = os.path.join(args.art_dir, f"profile_{stamp}_summary.json")
+    with open(summary_path, "w") as f:
+        json.dump(summary, f, indent=1)
+    log(f"summary written: {summary_path}")
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

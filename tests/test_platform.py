@@ -187,6 +187,10 @@ def test_bench_onchip_citation_helper():
     assert rec is not None
     assert rec["artifact"].startswith("docs/artifacts/battery_")
     assert rec["value"] > 0 and rec["utc"]
+    # The citation names the ON-CHIP config: a fallback row's own metric
+    # names the reduced CPU config, and without this label a reader can
+    # read onchip_value as a measurement of that config (round-4 weak #5).
+    assert "single chip" in rec["metric"]
 
     # Malformed artifact lines (non-dict JSON, truncation, bad results
     # entries) must be skipped, not raise — drop hostile files into the

@@ -41,6 +41,55 @@ def test_kernel_bench_smoke_emits_parseable_rows():
         assert row["interpret_mode"] is True
 
 
+def test_profile_capture_smoke_contract(tmp_path):
+    """--smoke must emit the bench row (stamped profiled) plus a
+    profile_summary row, and land a gzipped capture + summary JSON in
+    --art-dir — the battery's profile stage rides this exact contract."""
+    r = _run_script(
+        "profile_capture.py", "--smoke", "--art-dir", str(tmp_path),
+        timeout=900,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows = [json.loads(line) for line in r.stdout.strip().splitlines()]
+    bench_rows = [x for x in rows if "metric" in x]
+    summaries = [x for x in rows if x.get("kind") == "profile_summary"]
+    assert bench_rows and bench_rows[0].get("profiled") is True
+    assert "SMOKE" in bench_rows[0]["metric"]
+    assert len(summaries) == 1
+    s = summaries[0]
+    # CPU traces carry no device-plane op rows; the contract is that the
+    # summary says so explicitly (op_rows present, possibly 0) rather
+    # than failing — and the raw capture is still committed for offline
+    # re-parse.
+    assert "op_rows" in s and "measured_hbm_bytes" in s
+    assert s["capture"] is None or list(tmp_path.glob("*.xplane.pb.gz"))
+    assert list(tmp_path.glob("profile_*_summary.json"))
+
+
+def test_profile_capture_cpu_fallback_never_latches_ok(tmp_path):
+    """A NON-smoke run whose bench lands on CPU (wedged tunnel) must exit
+    nonzero and commit no capture — otherwise the battery records the
+    profile stage ok and --skip-done skips the on-chip calibration
+    forever (round-5 review finding). P2P_BENCH_SMOKE keeps the child
+    bench tiny while profile_capture itself runs in real (non-smoke)
+    mode, so the metric still carries the CPU label that must trip it."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["P2P_BENCH_SMOKE"] = "1"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "profile_capture.py"),
+         "--art-dir", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900,
+    )
+    assert r.returncode == 1, (r.stdout, r.stderr[-1000:])
+    rows = [json.loads(line) for line in r.stdout.strip().splitlines()]
+    summaries = [x for x in rows if x.get("kind") == "profile_summary"]
+    assert summaries and "re-fire" in summaries[0]["error"]
+    assert "CPU" in summaries[0]["bench_metric"]
+    assert not list(tmp_path.glob("*.xplane.pb.gz"))
+
+
 def test_protocol_compare_smoke_json():
     r = _run_script(
         "protocol_compare.py", "--json", "--nodes", "200", "--prob", "0.03",
