@@ -101,7 +101,7 @@ extern "C" {
 // Bump whenever any exported signature changes. runtime/native.py refuses a
 // library whose version doesn't match (a stale .so bound with the wrong
 // argument layout would corrupt memory) and falls back to the Python engine.
-int64_t gossip_abi_version() { return 6; }
+int64_t gossip_abi_version() { return 7; }
 
 // Runs the event-driven simulation. Returns the number of events processed
 // (heap pops), the metric NS-3-style engines are measured by. Snapshot
@@ -122,12 +122,21 @@ int64_t gossip_abi_version() { return 6; }
 // connect_tick models the reference's socket warm-up window
 // (p2pnetwork.cc:93-96): a broadcast before it finds no sockets — nothing
 // sent, nothing charged (p2pnode.cc:131-135). 0 = connected from t0.
+//
+// FIFO link queueing (models/latency.py::FifoLinkModel semantics — the
+// reference's NS-3 DataRate serialization, p2pnetwork.cc:113):
+// fifo_ser_micro > 0 makes messages on one directed link serialize through
+// a per-link queue; csr_delays then carry pure propagation latency. All
+// queue arithmetic is int64 micro-ticks (1e-6 tick) and every tick's
+// broadcasts are served in ascending (node, share) — the canonical order
+// the Python engine uses — so counters stay bit-identical under
+// contention. 0 = off (each message charged its csr_delay independently).
 int64_t gossip_run_event_sim(
     int64_t n, const int64_t* indptr, const int32_t* indices,
     const int32_t* csr_delays, int64_t num_shares, const int32_t* origins,
     const int32_t* gen_ticks, int64_t horizon, int64_t connect_tick,
     int64_t churn_k, const int32_t* churn_start, const int32_t* churn_end,
-    int64_t loss_threshold, int64_t loss_seed,
+    int64_t loss_threshold, int64_t loss_seed, int64_t fifo_ser_micro,
     int64_t num_snapshots, const int64_t* snapshot_ticks,
     int64_t* snap_generated, int64_t* snap_processed,
     int64_t* out_generated, int64_t* out_received, int64_t* out_sent) {
@@ -156,8 +165,47 @@ int64_t gossip_run_event_sim(
   };
 
   const uint32_t lseed = static_cast<uint32_t>(loss_seed);
+  const bool fifo = fifo_ser_micro > 0;
+  constexpr int64_t kMicro = 1000000;  // models/latency.py MICROTICKS
+  std::vector<int64_t> fifo_busy;      // per-directed-link, micro-ticks
+  std::vector<std::pair<int64_t, int64_t>> fifo_pending;  // (node, share)
+  if (fifo) fifo_busy.assign(static_cast<size_t>(indptr[n]), 0);
+
+  auto flush_fifo = [&](int64_t now) {
+    // Canonical same-tick service order (ascending (node, share), the
+    // Python engine's sorted(pending)): queue charging is order-
+    // dependent, and a shared order is what keeps cross-engine parity.
+    std::sort(fifo_pending.begin(), fifo_pending.end());
+    const int64_t now_micro = now * kMicro;
+    for (const auto& [node, share] : fifo_pending) {
+      const int64_t lo = indptr[node], hi = indptr[node + 1];
+      out_sent[node] += hi - lo;
+      for (int64_t e = lo; e < hi; ++e) {
+        const int64_t start = std::max(now_micro, fifo_busy[e]);
+        fifo_busy[e] = start + fifo_ser_micro;
+        int64_t t_arr =
+            (fifo_busy[e] + csr_delays[e] * kMicro + kMicro / 2) / kMicro;
+        t_arr = std::max(t_arr, now + 1);
+        // Loss before horizon (outcome precedence parity with the
+        // Python engine); either way the link was occupied — busy is
+        // already charged.
+        if (loss_drop(node, indices[e], t_arr, loss_threshold, lseed)) {
+          continue;
+        }
+        if (t_arr >= horizon) continue;
+        heap.emplace(t_arr, payload(false, indices[e], share));
+      }
+    }
+    fifo_pending.clear();
+  };
+
   auto broadcast = [&](int64_t node, int64_t share, int64_t now) {
     if (now < connect_tick) return;  // warm-up: no sockets, no charge
+    if (fifo) {
+      // Defer to the tick-end flush (canonical service order).
+      fifo_pending.emplace_back(node, share);
+      return;
+    }
     const int64_t lo = indptr[node], hi = indptr[node + 1];
     out_sent[node] += hi - lo;
     for (int64_t e = lo; e < hi; ++e) {
@@ -177,9 +225,19 @@ int64_t gossip_run_event_sim(
     return true;
   };
 
-  while (!heap.empty()) {
+  int64_t cur_t = 0;
+  while (true) {
+    // Tick boundary (checked at the loop head, like the Python engine:
+    // duplicate/churn drops must not skip it, and the flush may refill
+    // an empty heap — flushed arrivals are all >= cur_t + 1).
+    if (fifo && !fifo_pending.empty() &&
+        (heap.empty() || heap.top().first > cur_t)) {
+      flush_fifo(cur_t);
+    }
+    if (heap.empty()) break;
     const auto [t, p] = heap.top();
     heap.pop();
+    cur_t = t;
     take_snapshots(t);
     ++events;
     const int64_t node = (p >> 32) & 0x7fffffff;

@@ -47,6 +47,7 @@ def run_event_sim(
     loss=None,
     record_messages: bool = False,
     connect_tick: int = 0,
+    fifo_links=None,
 ) -> NodeStats:
     """Run the event-driven gossip simulation for ``horizon_ticks`` ticks.
 
@@ -75,6 +76,17 @@ def run_event_sim(
     pre-connect stay with their origin forever. 0 (default) =
     connected-from-t0, the rebuild's base semantics (SURVEY §1
     deviation 2).
+
+    ``fifo_links`` is an optional `models.latency.FifoLinkModel`:
+    messages on one directed link serialize through a FIFO queue (the
+    reference's NS-3 DataRate behavior, p2pnetwork.cc:113 — SURVEY
+    deviation #5) instead of each being charged an independent delay.
+    ``ell_delays``/``constant_delay`` then carry pure propagation
+    latency; serialization time lives in the model. All broadcasts of a
+    tick are enqueued in ascending (node, share) — a canonical order
+    shared with the C++ engine, which stays bit-identical under
+    contention (see FifoLinkModel). With no contention this reproduces
+    `serialization_delays`' closed form exactly.
 
     ``record_messages`` captures every transmitted message as
     ``stats.extra["messages"]`` — a list of (src, dst, share, tx_tick,
@@ -126,11 +138,78 @@ def run_event_sim(
 
         loss_threshold, loss_seed = loss.static_cfg
 
+    fifo = fifo_links is not None
+    if fifo:
+        from p2p_gossip_tpu.models.latency import MICROTICKS
+
+        ser_micro = fifo_links.ser_micro
+        # Per-directed-link "busy until" in integer micro-ticks, indexed
+        # by CSR entry (each directed entry IS one link-direction).
+        busy = np.zeros(indices.shape[0], dtype=np.int64)
+        pending: list[tuple[int, int]] = []  # (node, share) of this tick
+
+    def flush_fifo(now: int) -> None:
+        """Charge the tick's broadcasts through the link queues in the
+        canonical (node, share) order and schedule the arrivals. Safe to
+        run at tick end: all delays are >= 1 tick, so nothing flushed
+        here can pop at ``now``."""
+        nonlocal seq
+        now_micro = now * MICROTICKS
+        for node, share in sorted(pending):
+            lo, hi = indptr[node], indptr[node + 1]
+            sent[node] += hi - lo
+            # One message per link-direction: the whole broadcast charges
+            # each queue once, so the update vectorizes exactly.
+            start = np.maximum(now_micro, busy[lo:hi])
+            busy[lo:hi] = start + ser_micro
+            t_arrs = (
+                busy[lo:hi] + csr_delays[lo:hi] * MICROTICKS
+                + MICROTICKS // 2
+            ) // MICROTICKS
+            np.maximum(t_arrs, now + 1, out=t_arrs)
+            if loss is not None:
+                dropped = drop_mask_np(
+                    node, indices[lo:hi], t_arrs, loss_threshold, loss_seed,
+                )
+            for k, e in enumerate(range(lo, hi)):
+                t_arr = int(t_arrs[k])
+                dst = int(indices[e])
+                # Same outcome precedence as the per-message path: a
+                # dropped message was lost first even if also
+                # past-horizon. Either way it OCCUPIED the link (the
+                # transmission happened; busy is already charged).
+                if loss is not None and dropped[k]:
+                    if record_messages:
+                        messages.append(
+                            [node, dst, share, now, t_arr, "lost"]
+                        )
+                    continue
+                if t_arr >= horizon_ticks:
+                    if record_messages:
+                        messages.append(
+                            [node, dst, share, now, t_arr, "horizon"]
+                        )
+                    continue
+                if record_messages:
+                    msg_by_seq[seq] = len(messages)
+                    messages.append(
+                        [node, dst, share, now, t_arr, "delivered"]
+                    )
+                heapq.heappush(heap, (t_arr, seq, 1, dst, share))
+                seq += 1
+        pending.clear()
+
     def broadcast(node: int, share: int, now: int) -> None:
         nonlocal seq
         if now < connect_tick:
             # Warm-up window: no sockets yet — nothing sent, nothing
-            # charged (p2pnode.cc:131-135).
+            # charged (p2pnode.cc:131-135), and (fifo) no queue occupied.
+            return
+        if fifo:
+            # Defer to the tick-end flush: the canonical (node, share)
+            # service order can only be established once the tick's full
+            # broadcast set is known.
+            pending.append((node, share))
             return
         lo, hi = indptr[node], indptr[node + 1]
         sent[node] += hi - lo
@@ -196,7 +275,17 @@ def run_event_sim(
         def is_up(node: int, t: int) -> bool:
             return not ((c_start[node] <= t) & (t < c_end[node])).any()
 
-    while heap:
+    t = 0
+    while True:
+        if fifo and pending and (not heap or heap[0][0] > t):
+            # Tick boundary: every event of tick t has popped (ticks are
+            # popped in nondecreasing order and flushed arrivals are all
+            # >= t+1). Checked at the loop head — the body's `continue`
+            # paths (duplicates, churn drops) must not skip it — and the
+            # flush may refill an empty heap, so it also gates the exit.
+            flush_fifo(t)
+        if not heap:
+            break
         t, ev_seq, kind, node, share = heapq.heappop(heap)
         take_snapshots(t)
         events_processed += 1

@@ -233,6 +233,26 @@ def auto_chunk_shares(
         ) <= budget_bytes:
             break
         chunk = max(min_chunk, chunk // 2)
+    if chunk < default_pad:
+        floor_model = flood_resident_hbm_bytes(
+            degree, bitmask.num_words(chunk), block, ring_size, uniform_delay
+        )
+        if floor_model > budget_bytes:
+            # The min_chunk floor is NOT a fit: the model's fixed terms
+            # (the staged ELL) alone exceed the budget, so the returned
+            # pad is merely the least-bad staging. Callers log staging
+            # plans from this value — without an explicit signal the
+            # plan reads as budget-approved (round-4 advisor finding).
+            import warnings
+
+            warnings.warn(
+                f"auto_chunk_shares: budget {budget_bytes / 1e9:.1f} GB "
+                f"cannot be met — pad {chunk} still models "
+                f"{floor_model / 1e9:.1f} GB (fixed ELL terms dominate); "
+                "returning the floor anyway",
+                RuntimeWarning,
+                stacklevel=2,
+            )
     return None if chunk == default_pad else chunk
 
 

@@ -81,6 +81,22 @@ def serialization_delays(
     the default per-hop delay.) Uniform across edges (the reference
     gives every link one DataRate), so the uniform-delay fast path
     applies.
+
+    PER-MESSAGE, NOT QUEUED (SURVEY deviation #5): each message is
+    charged an independent size/bandwidth delay, whereas the reference's
+    NS-3 TCP stack serializes concurrent messages on one link through a
+    FIFO queue — the j-th message of a burst waits (j-1)*S*8/BW extra.
+    For the reference's actual traffic the difference is unobservable:
+    queueing only changes the integer-tick quantization when a burst of
+    >= tick_dt/(2*ser) messages shares one link-direction within one
+    latency window (~52 messages at 30 B / 5 Mbps / 5 ms ticks), while
+    dedup caps each share at ONE crossing per link-direction and the
+    reference generates ~0.3 shares/s/node — per-link occupancy per
+    5 ms window is ~1e-2 messages, so a 52-deep burst never occurs.
+    Queue buildup under loads where it WOULD matter is modeled
+    first-class by the event engines' opt-in FIFO link model
+    (``fifo_links`` on run_event_sim / the native engine), which charges
+    the exact per-link waiting time instead of this closed form.
     """
     if latency_ticks < 1:
         raise ValueError("latency_ticks must be >= 1")
@@ -93,6 +109,62 @@ def serialization_delays(
     # floor(x + 0.5): half-up, immune to float banker's rounding.
     ticks = max(1, int(np.floor(total_s / tick_dt + 0.5)))
     return np.full((graph.n, graph.ell_width), ticks, dtype=np.int32)
+
+
+#: Sub-tick time unit for the FIFO link model: all queue arithmetic runs
+#: in integer micro-ticks (1e-6 tick) so the Python and C++ event engines
+#: compute bit-identical arrival times (no float divergence).
+MICROTICKS = 1_000_000
+
+
+class FifoLinkModel:
+    """Opt-in FIFO link queueing for the event engines (SURVEY dev. #5).
+
+    The reference's NS-3 TCP stack serializes concurrent messages on one
+    5 Mbps link through the device queue (`ConnectNodes` DataRate,
+    p2pnetwork.cc:113): message j of a same-link burst starts
+    transmitting only when j-1's last bit has left. This model
+    reproduces that behavior exactly at the app layer: each directed
+    link carries a ``busy_until`` time in integer micro-ticks; a message
+    sent at tick ``t`` starts at ``max(t, busy_until)``, holds the link
+    for ``ser_micro`` micro-ticks, and arrives ``latency`` ticks after
+    its last bit leaves. The total is rounded half-up to a whole tick
+    and floored at ``t + 1`` (the same quantization as
+    ``serialization_delays``, so an UNCONTENDED run under this model is
+    bitwise-identical to the closed-form per-message path — the parity
+    test in tests/test_event_engine.py pins this).
+
+    Same-tick service order is canonical — all broadcasts of one tick
+    are enqueued in ascending (node, share) — so the Python and C++
+    engines charge every queue identically and stay bit-parity under
+    contention. Event order within a tick cannot matter any other way:
+    delays are >= 1 tick, so nothing sent at tick t is processed at t.
+    """
+
+    __slots__ = ("ser_micro",)
+
+    def __init__(self, ser_micro: int):
+        if ser_micro < 0:
+            raise ValueError("ser_micro must be >= 0")
+        self.ser_micro = int(ser_micro)
+
+
+def fifo_link_model(
+    message_bytes: int = 30,
+    bandwidth_mbps: float = 5.0,
+    tick_dt: float = 0.005,
+) -> FifoLinkModel:
+    """`FifoLinkModel` from the reference's physical link parameters:
+    serialization time S*8/BW quantized to integer micro-ticks (half-up).
+    Reference defaults (30 B, 5 Mbps, 5 ms ticks) give 9600 micro-ticks
+    — 0.0096 of a tick, which is why queueing is unobservable in the
+    reference's own workload (see ``serialization_delays``)."""
+    if message_bytes < 0:
+        raise ValueError("message_bytes must be >= 0")
+    if bandwidth_mbps <= 0 or tick_dt <= 0:
+        raise ValueError("bandwidth_mbps and tick_dt must be > 0")
+    ser_ticks = message_bytes * 8 / (bandwidth_mbps * 1e6) / tick_dt
+    return FifoLinkModel(int(np.floor(ser_ticks * MICROTICKS + 0.5)))
 
 
 def max_delay(ell_delays: np.ndarray) -> int:

@@ -31,7 +31,7 @@ _lib_checked = False
 # Must match gossip_abi_version() in native/gossip_native.cc. Binding a stale
 # .so with a different argument layout would scribble over the wrong buffers,
 # so a mismatch is treated as "not built".
-ABI_VERSION = 6
+ABI_VERSION = 7
 
 
 def _try_autobuild() -> None:
@@ -122,6 +122,7 @@ def _configure(lib) -> None:
         i32p, i32p,                  # churn_start, churn_end (n x churn_k)
         ctypes.c_int64,              # loss_threshold (0 = off)
         ctypes.c_int64,              # loss_seed
+        ctypes.c_int64,              # fifo_ser_micro (0 = off)
         ctypes.c_int64,              # num_snapshots
         i64p, i64p, i64p,            # snapshot_ticks, snap_generated, snap_processed
         i64p, i64p, i64p,            # out: generated, received, sent
@@ -197,11 +198,13 @@ def run_native_sim(
     churn=None,
     loss=None,
     connect_tick: int = 0,
+    fifo_links=None,
 ) -> NodeStats:
     """Event-driven simulation on the C++ engine (counters identical to
-    `engine.event.run_event_sim`, including under churn, link-loss, and
-    the socket warm-up window ``connect_tick``). Falls back to Python
-    when unbuilt."""
+    `engine.event.run_event_sim`, including under churn, link-loss, the
+    socket warm-up window ``connect_tick``, and the opt-in FIFO link
+    queueing ``fifo_links`` — a `models.latency.FifoLinkModel`). Falls
+    back to Python when unbuilt."""
     lib = load_library()
     if lib is None:
         warnings.warn(
@@ -212,7 +215,7 @@ def run_native_sim(
         return run_event_sim(
             graph, schedule, horizon_ticks, ell_delays, constant_delay,
             snapshot_ticks=snapshot_ticks, churn=churn, loss=loss,
-            connect_tick=connect_tick,
+            connect_tick=connect_tick, fifo_links=fifo_links,
         )
 
     n = graph.n
@@ -249,6 +252,7 @@ def run_native_sim(
         churn_end,
         loss.threshold if loss is not None else 0,
         loss.seed if loss is not None else 0,
+        fifo_links.ser_micro if fifo_links is not None else 0,
         len(boundaries),
         np.ascontiguousarray(boundaries) if len(boundaries) else snap_gen,
         snap_gen,

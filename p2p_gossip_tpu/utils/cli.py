@@ -151,6 +151,17 @@ def build_parser() -> argparse.ArgumentParser:
         "(reference: 5 Mbps, p2pnetwork.cc:113)",
     )
     p.add_argument(
+        "--linkQueueing", action="store_true",
+        help="FIFO link queueing (the reference's NS-3 DataRate behavior, "
+        "p2pnetwork.cc:113 — SURVEY deviation 5): concurrent messages on "
+        "one link serialize through a per-link queue sized by "
+        "--shareBytes / --bandwidthMbps, ON TOP of the propagation delay "
+        "model. Per-message engines only (--backend event|native, "
+        "--protocol push); incompatible with --delayModel serialization, "
+        "which already charges the closed-form per-message serialization "
+        "time (charging both would double it).",
+    )
+    p.add_argument(
         "--churnProb", type=float, default=0.0,
         help="Node churn: probability each node suffers a random outage "
         "(per outage slot; 0 disables churn). Down nodes lose arriving "
@@ -635,6 +646,56 @@ def run(argv=None) -> int:
             file=sys.stderr,
         )
 
+    fifo = None
+    if args.linkQueueing:
+        # The queue's state is data-dependent (whoever transmitted last
+        # holds the link), which only the per-message engines can track;
+        # the tick engines model serialization via the closed form
+        # (--delayModel serialization), exact for uncontended traffic.
+        if args.backend not in ("event", "native"):
+            print(
+                "error: --linkQueueing requires --backend event|native "
+                "(per-message engines; tick engines model serialization "
+                "via --delayModel serialization)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.protocol != "push":
+            print(
+                "error: --linkQueueing supports --protocol push only "
+                "(the partnered protocols are round-based digests, not "
+                "per-message transmissions)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.delayModel == "serialization":
+            print(
+                "error: --linkQueueing is incompatible with --delayModel "
+                "serialization (it would charge the serialization time "
+                "twice); use constant or lognormal for the propagation "
+                "part",
+                file=sys.stderr,
+            )
+            return 2
+        if args.shareBytes < 0 or args.bandwidthMbps <= 0:
+            print(
+                "error: --shareBytes must be >= 0 and --bandwidthMbps > 0",
+                file=sys.stderr,
+            )
+            return 2
+        from p2p_gossip_tpu.models.latency import fifo_link_model
+
+        fifo = fifo_link_model(
+            message_bytes=args.shareBytes,
+            bandwidth_mbps=args.bandwidthMbps, tick_dt=tick_dt,
+        )
+        print(
+            f"FIFO link queueing: {args.shareBytes} B at "
+            f"{args.bandwidthMbps:g} Mbps -> {fifo.ser_micro} micro-ticks "
+            "serialization per message per link",
+            file=sys.stderr,
+        )
+
     if args.degreeBlock < 0:
         print("error: --degreeBlock must be >= 0", file=sys.stderr)
         return 2
@@ -884,6 +945,7 @@ def run(argv=None) -> int:
         stats = run_native_sim(
             g, sched, horizon, ell_delays=delays, snapshot_ticks=snapshot_ticks,
             churn=churn, loss=loss, connect_tick=args.connectAtTick,
+            fifo_links=fifo,
         )
     else:
         from p2p_gossip_tpu.engine.event import run_event_sim
@@ -891,7 +953,7 @@ def run(argv=None) -> int:
         stats = run_event_sim(
             g, sched, horizon, ell_delays=delays, snapshot_ticks=snapshot_ticks,
             churn=churn, loss=loss, record_messages=args.animMessages,
-            connect_tick=args.connectAtTick,
+            connect_tick=args.connectAtTick, fifo_links=fifo,
         )
     wall = time.perf_counter() - t0
 

@@ -148,6 +148,28 @@ def main() -> int:
         seed=args.seed,
     )
 
+    # Host-fit arithmetic (shared by the auto-shrink preflight below and
+    # the emitted rows): the virtual mesh concentrates every shard in ONE
+    # process, so pad width drives host RSS. avail is read once at
+    # startup; because it moves with unrelated processes, the chosen pad
+    # — and therefore the ring-bytes rows — can differ between runs of
+    # the same command, which is why each row now records pad_shares +
+    # host_avail_gb so artifacts are self-describing (round-4 advisor).
+    from p2p_gossip_tpu.ops.bitmask import num_words
+
+    avail = float(os.environ.get("P2P_HOST_BUDGET_GB", "0")) * 1e9
+    if not avail:
+        avail = 0.7 * os.sysconf("SC_AVPHYS_PAGES") * os.sysconf(
+            "SC_PAGE_SIZE"
+        )
+    fw_ell = graph.n * graph.max_degree * 9
+    ring_slots_model = args.delay_max_ticks + 1
+
+    def host_total(pad):
+        row = num_words(max(args.shares, pad)) * 4
+        rings = args.devices * ring_slots_model * graph.n * row
+        return fw_ell + rings + 6 * graph.n * row
+
     if not args.chunkSize:
         # Host-fit preflight: the virtual mesh concentrates every shard in
         # ONE process, so the default 4096-share pad — deliberately
@@ -161,21 +183,6 @@ def main() -> int:
         # exceeds available RAM, and say so loudly — a shrunk pad keeps
         # every parity/coverage check but stops modeling the real
         # config-5 ring bytes.
-        from p2p_gossip_tpu.ops.bitmask import num_words
-
-        avail = float(os.environ.get("P2P_HOST_BUDGET_GB", "0")) * 1e9
-        if not avail:
-            avail = 0.7 * os.sysconf("SC_AVPHYS_PAGES") * os.sysconf(
-                "SC_PAGE_SIZE"
-            )
-        fw_ell = graph.n * graph.max_degree * 9
-        ring_slots = args.delay_max_ticks + 1
-
-        def host_total(pad):
-            row = num_words(max(args.shares, pad)) * 4
-            rings = args.devices * ring_slots * graph.n * row
-            return fw_ell + rings + 6 * graph.n * row
-
         pad = 4096
         while pad > 32 and host_total(pad) > avail:
             pad //= 2
@@ -189,6 +196,24 @@ def main() -> int:
                 "checks are unaffected; ring-bytes rows no longer model "
                 "the real config-5 footprint."
             )
+    # The pad the engine actually stages: a chunkSize below the share
+    # count cannot narrow the rows past the shares themselves (the
+    # engine pads to whole 32-bit words of max(shares, chunk)) — record
+    # that width, not the raw flag, or the row misdescribes its own
+    # ring_bytes accounting.
+    from p2p_gossip_tpu.ops.bitmask import num_words as _nw
+
+    eff_pad = _nw(max(args.shares, args.chunkSize or 4096)) * 32
+    if host_total(eff_pad) > avail:
+        # Not a silent floor: the preflight cannot shrink below 32, and
+        # an explicit --chunkSize is taken as given — either way the run
+        # proceeds, but the operator (and the artifact row, via
+        # host_fit_ok below) must see the model was not satisfied.
+        log(
+            f"WARNING host-fit NOT satisfied: pad {eff_pad} still models "
+            f"{host_total(eff_pad) / 1e9:.1f} GB > {avail / 1e9:.1f} GB "
+            "available; proceeding (OOM risk is the operator's)."
+        )
     n_delay_values = len(np.unique(delays[graph.ell()[1]]))
     rng = np.random.default_rng(args.seed)
     origins = rng.integers(0, graph.n, args.shares).astype(np.int32)
@@ -289,6 +314,13 @@ def main() -> int:
             "ring_mode": ring["mode"],
             "ring_slots": ring["slots"],
             "ring_bytes_per_chip": ring["bytes_per_chip"],
+            # Self-description (round-4 advisor): the pad the run really
+            # used, what the host had, and whether the fit model held —
+            # so two runs of the same command that chose different pads
+            # are distinguishable from their rows alone.
+            "pad_shares": eff_pad,
+            "host_avail_gb": round(avail / 1e9, 1),
+            "host_fit_ok": bool(host_total(eff_pad) <= avail),
             "coverage_final_min": int(np.asarray(cov_m)[-1].min()),
             "parity_vs_single_device": parity,
             "wall_s": round(wall, 1),

@@ -164,6 +164,12 @@ def main() -> None:
     if args.chunk:
         chunk = max(32, min(args.chunk, args.shares))
         pad = chunk
+        if mesh is not None:
+            log(
+                f"mesh: explicit --chunk forwards chunk_size={pad} to the "
+                "sharded engine (per-pass resident relief, not just origin "
+                "slicing)"
+            )
     else:
         # Auto: fit the resident-HBM model into the device budget. Only
         # the single-chip TPU path is budgeted by default — the host has
@@ -188,14 +194,26 @@ def main() -> None:
         chunk = args.shares if pad is None else min(pad, args.shares)
         if pad is not None:
             default_w = num_words(max(args.shares, MIN_CHUNK_SHARES))
+            pad_model = flood_resident_hbm_bytes(
+                graph.degree, num_words(pad), args.block
+            )
             log(
                 f"auto-chunk: default pad models "
                 f"{flood_resident_hbm_bytes(graph.degree, default_w, args.block) / 1e9:.1f} GB "
                 f"resident > {budget / 1e9:.1f} GB budget; padding to "
-                f"{pad} shares "
-                f"({flood_resident_hbm_bytes(graph.degree, num_words(pad), args.block) / 1e9:.1f} GB)"
+                f"{pad} shares ({pad_model / 1e9:.1f} GB)"
                 + (f", {chunk} origins per pass" if chunk < args.shares else "")
             )
+            if pad_model > budget:
+                # auto_chunk_shares floored at min_chunk without meeting
+                # the budget (it warns too); say so here in the staging
+                # log, or the plan above reads as budget-approved.
+                log(
+                    f"WARNING auto-chunk budget NOT satisfied: pad {pad} "
+                    f"still models {pad_model / 1e9:.1f} GB "
+                    f"> {budget / 1e9:.1f} GB (fixed ELL terms dominate); "
+                    "proceeding with the least-bad staging."
+                )
 
     def flood_all():
         """Shares are independent: chunked passes, counters additive."""
@@ -210,6 +228,13 @@ def main() -> None:
                 stats, cov = run_sharded_flood_coverage(
                     graph, origins[lo : lo + chunk], args.horizon, mesh,
                     block=args.block,
+                    # An explicit --chunk promises resident-footprint
+                    # relief on the mesh path too (as mesh_rehearsal.py
+                    # does): without forwarding it, each sliced pass is
+                    # re-padded to the sharded engine's 4096-share
+                    # default — extra passes, no memory relief
+                    # (round-4 advisor finding).
+                    **({"chunk_size": pad} if args.chunk else {}),
                 )
             else:
                 stats, cov = run_flood_coverage(

@@ -493,3 +493,37 @@ def test_ref_parallel_links_flag():
         "--refParallelLinks", "--connectAtTick", "100", "--backend", "event",
     )
     assert bad3.returncode == 2 and "--connectAtTick" in bad3.stderr
+
+
+def test_link_queueing_flag_and_guards():
+    """--linkQueueing (FIFO link model, SURVEY deviation 5) runs on the
+    per-message backends with identical event/native counters, and every
+    invalid combination is a clean CLI error, not a crash."""
+    common = [
+        "--numNodes", "16", "--connectionProb", "0.2", "--simTime", "10",
+        "--Latency", "5", "--seed", "2", "--linkQueueing",
+    ]
+    ev = _run_cli(*common, "--backend", "event")
+    assert ev.returncode == 0, ev.stderr
+    assert "FIFO link queueing" in ev.stderr
+    nat = _run_cli(*common, "--backend", "native")
+    assert nat.returncode == 0, nat.stderr
+    # Same seeds, same model -> same per-node statistics block (compare
+    # everything but the wall-clock line, which is timing, not counters).
+    def stats_lines(out):
+        return [
+            line for line in out[out.index("Node 0:"):].splitlines()
+            if " wall " not in line
+        ]
+
+    assert stats_lines(ev.stdout) == stats_lines(nat.stdout)
+
+    r = _run_cli(*common, "--backend", "tpu")
+    assert r.returncode == 2 and "requires --backend event|native" in r.stderr
+    r = _run_cli(*common, "--backend", "event", "--protocol", "pushpull")
+    assert r.returncode == 2 and "--protocol push only" in r.stderr
+    r = _run_cli(*common, "--backend", "event",
+                 "--delayModel", "serialization")
+    assert r.returncode == 2 and "twice" in r.stderr
+    r = _run_cli(*common, "--backend", "event", "--bandwidthMbps", "0")
+    assert r.returncode == 2 and "--bandwidthMbps > 0" in r.stderr

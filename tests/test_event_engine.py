@@ -151,3 +151,125 @@ def test_record_messages_off_by_default():
     sched = pg.uniform_renewal_schedule(20, sim_time=2.0, tick_dt=0.01, seed=1)
     stats = run_event_sim(g, sched, 200)
     assert "messages" not in stats.extra
+
+
+# --- FIFO link queueing (SURVEY deviation #5; models/latency.py) --------
+
+
+def test_fifo_uncontended_matches_serialization_closed_form():
+    """With reference-scale serialization (48 us on 5 ms ticks) queueing
+    never changes the integer-tick quantization, so the FIFO model must
+    be bitwise-identical to the closed-form per-message path
+    (serialization_delays) on the same traffic — the 'exact for the
+    reference's workload' claim, pinned."""
+    import p2p_gossip_tpu as pg
+    from p2p_gossip_tpu.models.latency import (
+        constant_delays,
+        fifo_link_model,
+        serialization_delays,
+    )
+
+    g = erdos_renyi(60, 0.08, seed=1)
+    rng = np.random.default_rng(0)
+    sched = Schedule(
+        g.n,
+        rng.integers(0, g.n, 40).astype(np.int32),
+        rng.integers(0, 12, 40).astype(np.int32),
+    )
+    closed = run_event_sim(
+        g, sched, 64,
+        ell_delays=serialization_delays(
+            g, latency_ticks=2, message_bytes=30, bandwidth_mbps=5.0,
+            tick_dt=0.005,
+        ),
+    )
+    fifo = run_event_sim(
+        g, sched, 64, ell_delays=constant_delays(g, 2),
+        fifo_links=fifo_link_model(30, 5.0, 0.005),
+    )
+    assert fifo.equal_counts(closed)
+    fifo.check_conservation()
+
+
+def test_fifo_contention_queues_same_link_burst():
+    """Three shares generated at one origin in the same tick serialize
+    through each link's queue: with 0.7-tick serialization the third
+    message's arrival lands a whole tick after the first two — the queue
+    buildup the closed form cannot express, hand-computed."""
+    import p2p_gossip_tpu as pg
+    from p2p_gossip_tpu.models.latency import FifoLinkModel, constant_delays
+
+    g = pg.Graph.from_edges(3, [(0, 1), (1, 2)])  # path 0-1-2
+    sched = Schedule(
+        3,
+        np.zeros(3, dtype=np.int32),
+        np.zeros(3, dtype=np.int32),
+    )
+    stats = run_event_sim(
+        g, sched, 32, ell_delays=constant_delays(g, 1),
+        fifo_links=FifoLinkModel(700_000), coverage_slots=3,
+    )
+    arr = stats.extra["arrival_ticks"]
+    # Link 0->1, canonical ascending-share service: departures at 0.7 /
+    # 1.4 / 2.1 ticks, +1 tick latency, rounded half-up: 2, 2, 3.
+    assert arr[0].tolist() == [0, 2, 4]
+    assert arr[1].tolist() == [0, 2, 4]
+    # Share 2: arrives node1 at 3; node1's 1->2 queue already served
+    # shares 0/1 at 2.7/3.4 us-ticks, so share 2 departs 4.1, arrives
+    # 5.1 -> tick 5.
+    assert arr[2].tolist() == [0, 3, 5]
+    stats.check_conservation()
+
+
+def test_fifo_native_bit_parity_fuzz():
+    """The C++ engine must agree bit-for-bit with the Python engine
+    under FIFO queueing across random graphs, delays, serialization
+    times, loss, and churn — the canonical same-tick service order is
+    what makes this possible."""
+    import pytest
+
+    from p2p_gossip_tpu.models.churn import random_churn
+    from p2p_gossip_tpu.models.latency import (
+        FifoLinkModel,
+        constant_delays,
+    )
+    from p2p_gossip_tpu.models.linkloss import LinkLossModel
+    from p2p_gossip_tpu.runtime import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng0 = np.random.default_rng(7)
+    for trial in range(8):
+        n = int(rng0.integers(20, 100))
+        g = erdos_renyi(
+            n, float(rng0.uniform(0.04, 0.15)), seed=int(rng0.integers(1e6))
+        )
+        shares = int(rng0.integers(3, 24))
+        sched = Schedule(
+            g.n,
+            rng0.integers(0, g.n, shares).astype(np.int32),
+            rng0.integers(0, 10, shares).astype(np.int32),
+        )
+        delays = (
+            lognormal_delays(g, max_ticks=4, seed=trial)
+            if trial % 2
+            else constant_delays(g, 1)
+        )
+        fl = FifoLinkModel(int(rng0.integers(1, 2_500_000)))
+        loss = LinkLossModel(0.15, seed=trial) if trial % 3 == 0 else None
+        churn = (
+            random_churn(g.n, 48, outage_prob=0.2, seed=trial)
+            if trial % 4 == 0
+            else None
+        )
+        py = run_event_sim(
+            g, sched, 48, ell_delays=delays, fifo_links=fl, loss=loss,
+            churn=churn,
+        )
+        cc = native.run_native_sim(
+            g, sched, 48, ell_delays=delays, fifo_links=fl, loss=loss,
+            churn=churn,
+        )
+        assert py.equal_counts(cc), f"trial {trial}"
+        if loss is None and churn is None:
+            py.check_conservation()

@@ -152,6 +152,26 @@ def test_scale_1m_auto_chunk_budget():
     assert "full coverage: True" in r.stderr
 
 
+def test_scale_1m_mesh_explicit_chunk_forwards_pad():
+    """--chunk in mesh mode must reach the sharded engine as chunk_size
+    (per-pass resident relief), not just slice origins into re-padded
+    passes (round-4 advisor finding). The forwarding is announced on
+    stderr and the run must still reach full coverage."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "scale_1m.py"),
+         "--cpu", "--nodes", "600", "--prob", "0.02", "--shares", "64",
+         "--horizon", "32", "--block", "8", "--mesh", "1x2",
+         "--chunk", "32"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=420,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "forwards chunk_size=32" in r.stderr
+    assert "full coverage: True" in r.stderr
+
+
 def test_mesh_rehearsal_cache_roundtrip(tmp_path):
     """--cache writes the graph with scale_1m.py's fingerprint scheme on
     the first run and loads it on the second (the 1M rehearsal reuses the
@@ -198,6 +218,30 @@ def test_mesh_rehearsal_ba_topology_and_chunk():
     # reflect the small pad, not the 4096-share default.
     repl = next(r2 for r2 in rows if r2["ring_mode"] == "replicated")
     assert repl["ring_bytes_per_chip"] == repl["ring_slots"] * 500 * 1 * 4
+    # Rows self-describe the pad/fit context (round-4 advisor finding):
+    # an explicit --chunkSize is recorded as the effective pad, alongside
+    # what the host had and whether the fit model held.
+    for row in rows:
+        assert row["pad_shares"] == 32
+        assert row["host_avail_gb"] > 0
+        assert row["host_fit_ok"] is True
+
+    # A chunkSize BELOW the share count cannot narrow the staged rows
+    # past the shares themselves: pad_shares must report the width the
+    # engine really stages (whole words of max(shares, chunk)), not the
+    # raw flag (round-5 review finding).
+    r2 = _run_script(
+        "mesh_rehearsal.py", "--nodes", "300", "--topology", "ba",
+        "--baM", "2", "--shares", "40", "--horizon", "24",
+        "--devices", "2", "--chunkSize", "32", "--skip-parity",
+    )
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    for line in r2.stdout.strip().splitlines():
+        row = json.loads(line)
+        assert row["pad_shares"] == 64  # num_words(max(40, 32)) * 32
+        # W=2 words; the node-sharded ring holds 1/devices of the rows.
+        ring_n = 300 if row["ring_mode"] == "replicated" else 150
+        assert row["ring_bytes_per_chip"] == row["ring_slots"] * ring_n * 2 * 4
 
 
 def test_mesh_rehearsal_partnered_protocol():
