@@ -138,7 +138,11 @@ def run_event_sim(
 
         loss_threshold, loss_seed = loss.static_cfg
 
-    fifo = fifo_links is not None
+    # ser_micro == 0 is OFF, matching the C++ engine's `fifo_ser_micro >
+    # 0` gate exactly — a zero-serialization queue is a no-op anyway
+    # (delays are >= 1 tick), but parity must rest on the shared gate,
+    # not on the no-op being accidental.
+    fifo = fifo_links is not None and fifo_links.ser_micro > 0
     if fifo:
         from p2p_gossip_tpu.models.latency import MICROTICKS
 

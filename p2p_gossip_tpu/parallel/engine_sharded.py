@@ -34,6 +34,8 @@ shapes and ring modes.
 from __future__ import annotations
 
 import functools
+import os
+import sys
 
 import numpy as np
 
@@ -56,12 +58,35 @@ from p2p_gossip_tpu.ops.ell import (
     DEFAULT_DEGREE_BLOCK,
     detect_uniform_delay,
     gather_or_frontier,
-    propagate,
     split_ell_by_delay,
     tuned_degree_block,
 )
 from p2p_gossip_tpu.parallel.mesh import NODES_AXIS, SHARES_AXIS, pad_to_multiple
 from p2p_gossip_tpu.utils.stats import NodeStats
+
+
+def _rss_log(tag: str) -> None:
+    """Staging-memory audit line, enabled by P2P_STAGE_RSS=1: current and
+    peak process RSS at each staging milestone. Exists because the 1M
+    scale-free virtual-mesh rehearsal OOM-killed a 125 GB host twice
+    with no visible culprit — one run under this flag localizes which
+    staging step owns the peak instead of guessing from models."""
+    if os.environ.get("P2P_STAGE_RSS") != "1":
+        return
+    import resource
+
+    peak_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    try:
+        with open("/proc/self/statm") as f:
+            cur_gb = (
+                int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE") / 1e9
+            )
+    except (OSError, ValueError, IndexError):
+        cur_gb = float("nan")
+    print(
+        f"[stage-rss] {tag}: cur {cur_gb:.1f} GB, peak {peak_gb:.1f} GB",
+        file=sys.stderr, flush=True,
+    )
 
 
 def _padded_device_graph(
@@ -82,7 +107,9 @@ def _padded_device_graph(
     ``with_mask=False``, since picks always land on valid ELL entries:
     both the uniform-delay scan and the (N, dmax) mask copy are skipped
     (the mask slot returns None)."""
+    _rss_log("padded_device_graph enter")
     ell_idx, ell_mask = graph.ell()
+    _rss_log("global ELL materialized")
     if ell_delays is None:
         ell_delays = np.full(ell_idx.shape, constant_delay, dtype=np.int32)
     ell_idx = pad_to_multiple(ell_idx, n_node_shards)
@@ -91,6 +118,7 @@ def _padded_device_graph(
         if uniform_placeholder
         else None
     )
+    _rss_log("uniform-delay detect done")
     ell_mask = pad_to_multiple(ell_mask, n_node_shards) if with_mask else None
     ring = (int(ell_delays.max()) if ell_delays.size else 1) + 1
     if uniform is not None:
@@ -173,7 +201,7 @@ def _resolve_and_stage_ring(
         ring_mode, uniform, ring, n_padded, n_node_shards, w
     )
     ell_args, delay_values = _stage_ell_args(
-        ring_mode, uniform, ell_idx, ell_delay, ell_mask
+        uniform, ell_idx, ell_delay, ell_mask
     )
     ring_extra = {
         "mode": ring_mode,
@@ -185,25 +213,32 @@ def _resolve_and_stage_ring(
 
 
 def _stage_ell_args(
-    ring_mode: str,
     uniform: int | None,
     ell_idx: np.ndarray,
     ell_delay: np.ndarray,
     ell_mask: np.ndarray,
 ):
-    """The runner's propagation operands for the resolved ring layout:
+    """The runner's propagation operands — layout-independent since the
+    delay-split unification (the ring layout only decides WHERE each
+    frontier slice is read from, in the runner's read_slice). Returns
     (ell_args flat tuple, static delay_values or None).
 
     - uniform delay (either layout): (idx, mask) — no delay array at all
-    - replicated per-edge: (idx, delay, mask)
-    - sharded per-edge: per-delay (idx_d, mask_d) pairs, one frontier
-      all_gather each (see split_ell_by_delay)
+    - per-edge delays (either layout): per-delay (idx_d, mask_d) pairs —
+      one single-frontier gather per distinct value, reading a local ring
+      slice (replicated) or an all_gathered one (sharded). One read plan
+      for both layouts: the replicated path used to stage the full-width
+      (idx, delay, mask) triple and run the dense `propagate` — at the
+      1M scale-free shape (dmax 4517) those are ~40 GB of operands plus
+      the same again in in-jit blocked transposes, which OOM-killed a
+      125 GB host three times (the delay-split plan needs no delay
+      operand at all and its packed columns carry no dead rows beyond
+      each value's own hub cap).
     """
     if uniform is not None:
         return (ell_idx, ell_mask), None
-    if ring_mode == "replicated":
-        return (ell_idx, ell_delay, ell_mask), None
     splits = split_ell_by_delay(ell_idx, ell_delay, ell_mask)
+    _rss_log("delay splits built")
     delay_values = tuple(d for d, _, _ in splits)
     ell_args = tuple(x for _, i, m in splits for x in (i, m))
     return ell_args, delay_values
@@ -353,16 +388,13 @@ def build_sharded_runner(
                     read_slice(hist, t, uniform_delay), t, ell_idx, ell_mask,
                     block=block, loss=loss, dst_ids=dst_ids,
                 )
-            if not sharded_ring:
-                ell_idx, ell_delay, ell_mask = ell_args
-                return propagate(
-                    hist, t, ell_idx, ell_delay, ell_mask,
-                    ring_size=ring_size, block=block,
-                    loss=loss, dst_ids=dst_ids,
-                )
-            # Sharded ring + per-edge delays: one single-frontier gather
-            # per distinct delay value (the delay-split ELLs partition the
-            # edge set, so the OR over parts equals the full-ELL gather).
+            # Per-edge delays, either ring layout: one single-frontier
+            # gather per distinct delay value (the delay-split ELLs
+            # partition the edge set, so the OR over parts equals the
+            # full-ELL gather; read_slice resolves local vs all_gathered
+            # per layout). The replicated layout used to run the dense
+            # `propagate` here — see _stage_ell_args for why that was
+            # replaced.
             acc = jnp.zeros((n_loc, w), dtype=jnp.uint32)
             for k, dval in enumerate(delay_values):
                 idx_d = ell_args[2 * k]
@@ -454,8 +486,7 @@ def build_sharded_runner(
         return received, sent, snaps, cov_hist
 
     n_ell_args = (
-        2 if uniform_delay is not None
-        else (3 if not sharded_ring else 2 * len(delay_values))
+        2 if uniform_delay is not None else 2 * len(delay_values)
     )
     mapped = shard_map(
         pass_fn,
@@ -656,17 +687,20 @@ def run_sharded_flood_coverage(
         ring_mode, uniform, ring, n_padded, mesh.shape[NODES_AXIS],
         bitmask.num_words(chunk_size), ell_idx, ell_delay, ell_mask,
     )
+    _rss_log("ring staged")
     runner, pass_size = build_sharded_runner(
         mesh, n_padded, ring, chunk_size, horizon_ticks, block, uniform,
         0, loss.static_cfg if loss is not None else None, True, cov_slots,
         ring_mode=ring_mode, delay_values=delay_values,
     )
     o, g_ticks = sched.padded(pass_size, horizon_ticks)
+    _rss_log("runner built")
     r, snt, _, cov = runner(
         ell_args, degree, churn_start, churn_end,
         o, g_ticks, np.int32(0), np.int32(0),
         np.zeros((0,), dtype=np.int32),
     )
+    _rss_log("runner executed")
     generated = effective_generated(sched, horizon_ticks, churn)
     received = np.asarray(r, dtype=np.int64)[: graph.n]
     stats = NodeStats(

@@ -201,9 +201,7 @@ def main() -> int:
     # engine pads to whole 32-bit words of max(shares, chunk)) — record
     # that width, not the raw flag, or the row misdescribes its own
     # ring_bytes accounting.
-    from p2p_gossip_tpu.ops.bitmask import num_words as _nw
-
-    eff_pad = _nw(max(args.shares, args.chunkSize or 4096)) * 32
+    eff_pad = num_words(max(args.shares, args.chunkSize or 4096)) * 32
     if host_total(eff_pad) > avail:
         # Not a silent floor: the preflight cannot shrink below 32, and
         # an explicit --chunkSize is taken as given — either way the run
