@@ -265,6 +265,54 @@ def split_ell_by_delay(ell_idx, ell_delay, ell_mask):
     return tuple(out)
 
 
+def bucket_rows_by_count(cnt, block: int, min_rows: int):
+    """THE bucketing policy, shared by `build_degree_buckets` (single
+    device) and `shard_bucket_ell` (sharded engine) so a tuning change
+    cannot drift between them: quantize per-row valid-entry counts to
+    levels (linear multiples of ``block``; geometric powers of two past
+    ``GEOMETRIC_LEVEL_THRESHOLD`` so heavy tails stay < 2x padded),
+    then merge small LINEAR-level groups upward until each holds
+    ``min_rows`` rows — tail levels always stand alone (merging would
+    pad hundreds of small rows to the hub cap). Returns a list of
+    row-index arrays in ascending level order; they partition
+    ``range(len(cnt))``."""
+    import numpy as np
+
+    cnt = np.asarray(cnt, dtype=np.int64)
+    level = -(-cnt // block)
+    high = level > GEOMETRIC_LEVEL_THRESHOLD
+    if high.any():
+        level = np.where(
+            high,
+            1 << np.ceil(np.log2(np.maximum(level, 1))).astype(np.int64),
+            level,
+        )
+    order = np.argsort(level, kind="stable")
+    sorted_level = level[order]
+    change = np.flatnonzero(np.diff(sorted_level)) + 1
+    groups = np.split(order, change)
+    merged: list[np.ndarray] = []
+    pending: list[np.ndarray] = []
+    pending_count = 0
+    for g in groups:
+        if level[g[0]] > GEOMETRIC_LEVEL_THRESHOLD:  # geometric group
+            if pending:
+                merged.append(np.concatenate(pending))
+                pending, pending_count = [], 0
+            merged.append(g)
+            continue
+        pending.append(g)
+        pending_count += g.shape[0]
+        if pending_count >= min_rows:
+            merged.append(np.concatenate(pending))
+            pending, pending_count = [], 0
+    if pending:
+        # Leftovers keep their own bucket: folding a tail into the previous
+        # bucket would raise that bucket's cap for every row.
+        merged.append(np.concatenate(pending))
+    return merged
+
+
 def build_degree_buckets(
     graph,
     ell_delays=None,
@@ -302,54 +350,13 @@ def build_degree_buckets(
     if ell is None and ell_delays is not None:
         ell = graph.ell()
     ell_idx, ell_mask = ell if ell is not None else (None, None)
-    level = (deg + block - 1) // block  # cap = level * block
-    # Heavy-tailed graphs (e.g. Barabási–Albert) have hundreds of distinct
-    # high-degree levels with a handful of nodes each; min_rows merging would
-    # fold them all into one bucket padded to the hub degree. Quantize levels
-    # geometrically past 8*block so within-bucket padding stays < 2x.
-    high = level > GEOMETRIC_LEVEL_THRESHOLD
-    if high.any():
-        level = np.where(
-            high,
-            1 << np.ceil(np.log2(np.maximum(level, 1))).astype(np.int64),
-            level,
-        )
-    order = np.argsort(level, kind="stable")
-    sorted_level = level[order]
-    # Split points where the level changes.
-    change = np.flatnonzero(np.diff(sorted_level)) + 1
-    groups = np.split(order, change)
-    # Merge small LINEAR-level groups upward (the next group's cap is
-    # higher, so padding stays valid). Geometric (tail) groups always stand
-    # alone: min_rows merging there would fold hundreds of small tail
-    # groups into one bucket padded to the hub degree.
-    merged: list[np.ndarray] = []
-    pending: list[np.ndarray] = []
-    pending_count = 0
-    for g in groups:
-        if level[g[0]] > GEOMETRIC_LEVEL_THRESHOLD:  # geometric group
-            if pending:
-                merged.append(np.concatenate(pending))
-                pending, pending_count = [], 0
-            merged.append(g)
-            continue
-        pending.append(g)
-        pending_count += g.shape[0]
-        if pending_count >= min_rows:
-            merged.append(np.concatenate(pending))
-            pending, pending_count = [], 0
-    if pending:
-        # Leftovers keep their own bucket: folding a tail into the previous
-        # bucket would raise that bucket's cap for every row.
-        merged.append(np.concatenate(pending))
+    merged = bucket_rows_by_count(deg, block, min_rows)
     buckets = []
     for rows in merged:
-        cap = int(level[rows].max()) * block
-        # Geometric (power-of-two) levels can sit up to ~2x above the
-        # bucket's true max degree — clamp to it (block-rounded) so hub
-        # buckets don't gather masked padding every tick.
-        tight = -(-int(deg[rows].max()) // block) * block
-        cap = max(min(cap, tight), block)
+        # Cap at the bucket's true max degree, block-rounded: geometric
+        # (power-of-two) levels can sit up to ~2x above it, and hub
+        # buckets must not gather masked padding every tick.
+        cap = max(-(-int(deg[rows].max()) // block) * block, block)
         if ell_idx is not None:
             b_idx = np.ascontiguousarray(ell_idx[rows, :cap])
             b_mask = np.ascontiguousarray(ell_mask[rows, :cap])
@@ -365,6 +372,84 @@ def build_degree_buckets(
                 else None,
             )
         )
+    return tuple(buckets)
+
+
+def shard_bucket_ell(
+    ell_idx,
+    ell_mask,
+    n_shards: int,
+    *,
+    block: int = DEFAULT_DEGREE_BLOCK,
+    min_rows: int = 2048,
+):
+    """Bucket one ELL (idx, mask) pair per node-shard with shard-uniform
+    shapes — degree bucketing for the `shard_map` engine.
+
+    The sharded engine's gathers used to pad every row shard to the
+    pair's global column cap: on the 1M scale-free graph (dmax 4517,
+    mean degree 6) that is ~750x masked gather traffic, the dominant
+    per-tick cost of the mesh path. `build_degree_buckets` fixes this on
+    one device, but its per-bucket shapes are data-dependent — under
+    SPMD every shard must run the same program on same-shaped operands.
+    Here rows are bucketed by their VALID-ENTRY count (works for the raw
+    ELL and for delay-split pairs alike) with a GLOBAL level structure:
+    the same count->level quantization as `build_degree_buckets` (linear
+    levels of ``block``, geometric past ``GEOMETRIC_LEVEL_THRESHOLD``),
+    levels merged upward until a group holds ``min_rows * n_shards``
+    rows, and every bucket's row capacity taken as the max over shards.
+
+    Returns a tuple of buckets ``(rows, idx, mask)`` with leading shard
+    axis: rows ``(S, R)`` int32 LOCAL row ids padded with ``n_loc`` (out
+    of range, so a ``mode="drop"`` scatter ignores them), idx/mask
+    ``(S, R, C)`` sliced from the pair's leading (front-packed) columns.
+    Zero-count rows appear in no bucket — they gather nothing, and the
+    consumer's scatter leaves their arrivals zero.
+    """
+    import numpy as np
+
+    ell_idx = np.asarray(ell_idx)
+    ell_mask = np.asarray(ell_mask)
+    n_padded, width = ell_idx.shape
+    assert n_padded % n_shards == 0, (n_padded, n_shards)
+    n_loc = n_padded // n_shards
+    cnt = ell_mask.sum(axis=1).astype(np.int64)
+    # Zero-count rows are excluded up front (they gather nothing and the
+    # consumer's scatter leaves them zero); the shared policy then groups
+    # the rest. min_rows scales by n_shards: the threshold bounds the
+    # TOTAL bucket count (each bucket is one gather per tick on every
+    # shard), not any one shard's rows.
+    nz = np.flatnonzero(cnt > 0)
+    row_groups = (
+        [nz[g] for g in bucket_rows_by_count(cnt[nz], block,
+                                             min_rows * n_shards)]
+        if nz.size
+        else [np.zeros(0, dtype=np.int64)]  # vacuous all-empty pair
+    )
+
+    shard_of = np.arange(n_padded, dtype=np.int64) // n_loc
+    local = (np.arange(n_padded, dtype=np.int64) % n_loc).astype(np.int32)
+    buckets = []
+    for grows in row_groups:
+        # Tight cap (block-rounded max valid count in the group), same
+        # clamp as build_degree_buckets.
+        grp_max = int(cnt[grows].max()) if grows.size else 1
+        cap = min(max(-(-grp_max // block) * block, 1), width)
+        per_shard = [
+            local[grows[shard_of[grows] == s]] for s in range(n_shards)
+        ]
+        r_cap = max(max(r.size for r in per_shard), 1)
+        rows_arr = np.full((n_shards, r_cap), n_loc, dtype=np.int32)
+        idx_arr = np.zeros((n_shards, r_cap, cap), dtype=ell_idx.dtype)
+        msk_arr = np.zeros((n_shards, r_cap, cap), dtype=bool)
+        for s, r in enumerate(per_shard):
+            if not r.size:
+                continue
+            rows_arr[s, : r.size] = r
+            gsl = r.astype(np.int64) + s * n_loc
+            idx_arr[s, : r.size] = ell_idx[gsl, :cap]
+            msk_arr[s, : r.size] = ell_mask[gsl, :cap]
+        buckets.append((rows_arr, idx_arr, msk_arr))
     return tuple(buckets)
 
 

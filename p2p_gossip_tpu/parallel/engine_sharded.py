@@ -58,6 +58,7 @@ from p2p_gossip_tpu.ops.ell import (
     DEFAULT_DEGREE_BLOCK,
     detect_uniform_delay,
     gather_or_frontier,
+    shard_bucket_ell,
     split_ell_by_delay,
     tuned_degree_block,
 )
@@ -192,24 +193,30 @@ def _resolve_and_stage_ring(
     ell_idx: np.ndarray,
     ell_delay: np.ndarray,
     ell_mask: np.ndarray,
+    block: int = DEFAULT_DEGREE_BLOCK,
+    bucket_min_rows: int = 2048,
 ):
     """Resolve the ring layout and stage its operands in one step — the
-    shared stanza of both sharded entry points. Returns
-    (ring_mode, ell_args, delay_values, ring_extra) where ``ring_extra``
-    is the ``stats.extra['ring']`` report dict."""
+    shared stanza of both sharded entry points. Returns (ring_mode,
+    ell_args, delay_values, bucket_counts, ring_extra) where
+    ``ring_extra`` is the ``stats.extra['ring']`` report dict and
+    ``bucket_counts`` is the static per-group bucket layout the runner
+    unflattens ``ell_args`` by."""
     ring_mode, ring_bytes = resolve_ring_mode(
         ring_mode, uniform, ring, n_padded, n_node_shards, w
     )
-    ell_args, delay_values = _stage_ell_args(
-        uniform, ell_idx, ell_delay, ell_mask
+    ell_args, delay_values, bucket_counts = _stage_ell_args(
+        uniform, ell_idx, ell_delay, ell_mask, n_node_shards, block,
+        bucket_min_rows,
     )
     ring_extra = {
         "mode": ring_mode,
         "bytes_per_chip": ring_bytes,
         "slots": ring,
         "delay_splits": len(delay_values) if delay_values else 1,
+        "degree_buckets": bucket_counts,
     }
-    return ring_mode, ell_args, delay_values, ring_extra
+    return ring_mode, ell_args, delay_values, bucket_counts, ring_extra
 
 
 def _stage_ell_args(
@@ -217,31 +224,49 @@ def _stage_ell_args(
     ell_idx: np.ndarray,
     ell_delay: np.ndarray,
     ell_mask: np.ndarray,
+    n_node_shards: int,
+    block: int,
+    bucket_min_rows: int,
 ):
     """The runner's propagation operands — layout-independent since the
     delay-split unification (the ring layout only decides WHERE each
     frontier slice is read from, in the runner's read_slice). Returns
-    (ell_args flat tuple, static delay_values or None).
+    (ell_args flat tuple, static delay_values or None, bucket_counts).
 
-    - uniform delay (either layout): (idx, mask) — no delay array at all
-    - per-edge delays (either layout): per-delay (idx_d, mask_d) pairs —
-      one single-frontier gather per distinct value, reading a local ring
-      slice (replicated) or an all_gathered one (sharded). One read plan
-      for both layouts: the replicated path used to stage the full-width
-      (idx, delay, mask) triple and run the dense `propagate` — at the
-      1M scale-free shape (dmax 4517) those are ~40 GB of operands plus
-      the same again in in-jit blocked transposes, which OOM-killed a
-      125 GB host three times (the delay-split plan needs no delay
-      operand at all and its packed columns carry no dead rows beyond
-      each value's own hub cap).
+    Operands are organized in GROUPS — one for the uniform delay, or one
+    per distinct delay value (per-edge delays: `split_ell_by_delay`;
+    the replicated path used to stage the full-width (idx, delay, mask)
+    triple and run the dense `propagate` — at the 1M scale-free shape
+    (dmax 4517) those are ~40 GB of operands plus the same again in
+    in-jit blocked transposes, which OOM-killed a 125 GB host three
+    times). Each group's (idx, mask) pair is then DEGREE-BUCKETED per
+    node shard (`shard_bucket_ell`) so a group's gather reads ~its own
+    valid entries instead of rows padded to the group's global column
+    cap — on hub-skewed graphs (1M BA: dmax 4517, mean degree 6) the
+    full-cap gather is ~750x masked traffic. ``ell_args`` is the flat
+    tuple of per-bucket (rows, idx, mask) triples in group order;
+    ``bucket_counts[g]`` says how many triples group g owns.
     """
     if uniform is not None:
-        return (ell_idx, ell_mask), None
-    splits = split_ell_by_delay(ell_idx, ell_delay, ell_mask)
-    _rss_log("delay splits built")
-    delay_values = tuple(d for d, _, _ in splits)
-    ell_args = tuple(x for _, i, m in splits for x in (i, m))
-    return ell_args, delay_values
+        groups = [(ell_idx, ell_mask)]
+        delay_values = None
+    else:
+        splits = split_ell_by_delay(ell_idx, ell_delay, ell_mask)
+        _rss_log("delay splits built")
+        delay_values = tuple(d for d, _, _ in splits)
+        groups = [(i, m) for _, i, m in splits]
+    ell_args: list = []
+    bucket_counts: list[int] = []
+    for idx_g, msk_g in groups:
+        buckets = shard_bucket_ell(
+            idx_g, msk_g, n_node_shards, block=block,
+            min_rows=bucket_min_rows,
+        )
+        bucket_counts.append(len(buckets))
+        for rows_b, idx_b, msk_b in buckets:
+            ell_args.extend((rows_b, idx_b, msk_b))
+    _rss_log("degree buckets staged")
+    return tuple(ell_args), delay_values, tuple(bucket_counts)
 
 
 def _stage_sharded_inputs(
@@ -287,6 +312,7 @@ def build_sharded_runner(
     ring_mode: str = "replicated",
     delay_values: tuple | None = None,
     connect_tick: int = 0,
+    bucket_counts: tuple = (1,),
 ):
     """Compile the per-pass runner: each shares-shard processes its own
     ``chunk_size`` shares over the row-sharded graph, from the chunk's first
@@ -294,8 +320,9 @@ def build_sharded_runner(
     mesh/shapes reuse the jitted executable.
 
     The first runner argument is the flat ``ell_args`` tuple staged by
-    `_stage_ell_args` for (``ring_mode``, ``uniform_delay``,
-    ``delay_values``); its layout is part of the compiled signature.
+    `_stage_ell_args` for (``uniform_delay``, ``delay_values``,
+    ``bucket_counts``); its layout — per-group degree buckets of
+    (rows, idx, mask) triples — is part of the compiled signature.
 
     ``num_snaps`` > 0 additionally returns (num_snaps, n_loc) received
     counts captured when the tick counter reaches each entry of the
@@ -333,14 +360,9 @@ def build_sharded_runner(
         # counts agree across devices); snap_ticks (num_snaps,) replicated.
         row_offset = lax.axis_index(NODES_AXIS).astype(jnp.int32) * n_loc
         slots = jnp.arange(chunk_size, dtype=jnp.int32)
-        # Global node ids of this shard's rows — the loss coin hashes
-        # global (src, dst) pairs so every mesh shape agrees with the
-        # single-device engines.
-        dst_ids = (
-            row_offset + jnp.arange(n_loc, dtype=jnp.int32)
-            if loss is not None
-            else None
-        )
+        # (Loss-coin dst ids are built per bucket inside arrivals_for:
+        # row_offset + the bucket's local rows — global ids, so every
+        # mesh shape agrees with the single-device engines.)
 
         state = (
             t_start,
@@ -382,28 +404,46 @@ def build_sharded_runner(
             return sl
 
         def arrivals_for(hist, t):
-            if uniform_delay is not None:
-                ell_idx, ell_mask = ell_args
-                return gather_or_frontier(
-                    read_slice(hist, t, uniform_delay), t, ell_idx, ell_mask,
-                    block=block, loss=loss, dst_ids=dst_ids,
-                )
-            # Per-edge delays, either ring layout: one single-frontier
-            # gather per distinct delay value (the delay-split ELLs
-            # partition the edge set, so the OR over parts equals the
-            # full-ELL gather; read_slice resolves local vs all_gathered
-            # per layout). The replicated layout used to run the dense
-            # `propagate` here — see _stage_ell_args for why that was
-            # replaced.
+            # One gather group per delay value (one group total under a
+            # uniform delay); read_slice resolves local vs all_gathered
+            # per ring layout. Within a group, the degree buckets
+            # partition this shard's rows (shard_bucket_ell), so each
+            # bucket gathers at its own tight column cap and one
+            # mode="drop" scatter reassembles the group's arrivals
+            # (padding rows carry id n_loc and fall out). Groups OR
+            # together: the delay-split ELLs partition the edge set, so
+            # the OR over groups equals the full-ELL gather.
+            group_delays = (
+                (uniform_delay,) if uniform_delay is not None
+                else delay_values
+            )
             acc = jnp.zeros((n_loc, w), dtype=jnp.uint32)
-            for k, dval in enumerate(delay_values):
-                idx_d = ell_args[2 * k]
-                msk_d = ell_args[2 * k + 1]
-                acc = acc | gather_or_frontier(
-                    read_slice(hist, t, dval), t, idx_d, msk_d,
-                    block=max(1, min(block, idx_d.shape[1])),
-                    loss=loss, dst_ids=dst_ids,
+            pos = 0
+            for gi, dval in enumerate(group_delays):
+                sl = read_slice(hist, t, dval)
+                cat_rows, cat_parts = [], []
+                for _ in range(bucket_counts[gi]):
+                    rows_b, idx_b, msk_b = ell_args[pos: pos + 3]
+                    pos += 3
+                    # Leading shard axis: this device's slice is row 0.
+                    rows_b, idx_b, msk_b = rows_b[0], idx_b[0], msk_b[0]
+                    part = gather_or_frontier(
+                        sl, t, idx_b, msk_b,
+                        block=max(1, min(block, idx_b.shape[1])),
+                        loss=loss,
+                        dst_ids=(
+                            row_offset + rows_b
+                            if loss is not None else None
+                        ),
+                    )
+                    cat_rows.append(rows_b)
+                    cat_parts.append(part)
+                grp = (
+                    jnp.zeros((n_loc, w), dtype=jnp.uint32)
+                    .at[jnp.concatenate(cat_rows)]
+                    .set(jnp.concatenate(cat_parts), mode="drop")
                 )
+                acc = acc | grp
             return acc
 
         def body(state):
@@ -485,14 +525,22 @@ def build_sharded_runner(
         snaps = lax.psum(snaps, SHARES_AXIS)
         return received, sent, snaps, cov_hist
 
-    n_ell_args = (
-        2 if uniform_delay is not None else 2 * len(delay_values)
+    # Per bucket triple: rows (S, R) + idx/mask (S, R, C), all with the
+    # shard axis leading — splitting it hands each device its own
+    # (1, ...) slice.
+    ell_specs = sum(
+        (
+            (P(NODES_AXIS, None), P(NODES_AXIS, None, None),
+             P(NODES_AXIS, None, None))
+            for _ in range(sum(bucket_counts))
+        ),
+        (),
     )
     mapped = shard_map(
         pass_fn,
         mesh=mesh,
         in_specs=(
-            tuple(P(NODES_AXIS, None) for _ in range(n_ell_args)),  # ell_args
+            ell_specs,            # ell_args (bucketed, see _stage_ell_args)
             P(NODES_AXIS),        # degree
             P(NODES_AXIS, None),  # churn_start
             P(NODES_AXIS, None),  # churn_end
@@ -528,6 +576,7 @@ def run_sharded_sim(
     stop_after_chunks: int | None = None,
     ring_mode: str = "auto",
     connect_tick: int = 0,
+    bucket_min_rows: int = 2048,
 ) -> NodeStats:
     """Drop-in counterpart of run_sync_sim/run_event_sim on a device mesh:
     identical per-node counters, any number of shares — including under a
@@ -562,16 +611,18 @@ def run_sharded_sim(
     )
     boundaries = filter_snapshot_boundaries(snapshot_ticks, horizon_ticks)
     snap_ticks_arr = np.asarray(boundaries, dtype=np.int32)
-    ring_mode, ell_args, delay_values, ring_extra = _resolve_and_stage_ring(
+    (ring_mode, ell_args, delay_values, bucket_counts,
+     ring_extra) = _resolve_and_stage_ring(
         ring_mode, uniform, ring, n_padded, mesh.shape[NODES_AXIS],
         bitmask.num_words(chunk_size), ell_idx, ell_delay, ell_mask,
+        block=block, bucket_min_rows=bucket_min_rows,
     )
     runner, pass_size = build_sharded_runner(
         mesh, n_padded, ring, chunk_size, horizon_ticks, block, uniform,
         len(boundaries),
         loss.static_cfg if loss is not None else None,
         ring_mode=ring_mode, delay_values=delay_values,
-        connect_tick=connect_tick,
+        connect_tick=connect_tick, bucket_counts=bucket_counts,
     )
 
     received = np.zeros(n_padded, dtype=np.int64)
@@ -660,6 +711,7 @@ def run_sharded_flood_coverage(
     churn=None,
     loss=None,
     ring_mode: str = "auto",
+    bucket_min_rows: int = 2048,
 ):
     """Flood coverage-time experiment on the device mesh — the BASELINE
     north-star metric (time-to-99% coverage at 1M nodes on a v5e-8 mesh)
@@ -683,15 +735,18 @@ def run_sharded_flood_coverage(
      churn_start, churn_end) = _stage_sharded_inputs(
         graph, ell_delays, constant_delay, mesh, block, churn
     )
-    ring_mode, ell_args, delay_values, ring_extra = _resolve_and_stage_ring(
+    (ring_mode, ell_args, delay_values, bucket_counts,
+     ring_extra) = _resolve_and_stage_ring(
         ring_mode, uniform, ring, n_padded, mesh.shape[NODES_AXIS],
         bitmask.num_words(chunk_size), ell_idx, ell_delay, ell_mask,
+        block=block, bucket_min_rows=bucket_min_rows,
     )
     _rss_log("ring staged")
     runner, pass_size = build_sharded_runner(
         mesh, n_padded, ring, chunk_size, horizon_ticks, block, uniform,
         0, loss.static_cfg if loss is not None else None, True, cov_slots,
         ring_mode=ring_mode, delay_values=delay_values,
+        bucket_counts=bucket_counts,
     )
     o, g_ticks = sched.padded(pass_size, horizon_ticks)
     _rss_log("runner built")

@@ -297,3 +297,38 @@ def test_multihost_bootstrap_and_mesh(tmp_path):
     )
     assert r.returncode == 0, r.stderr[-3000:]
     assert "MULTIHOST-OK" in r.stdout
+
+
+def test_degree_bucketed_sharded_gather_multi_bucket_parity():
+    """bucket_min_rows=1 on a hub-skewed (BA) graph forces the sharded
+    engine's multi-bucket gather regime (the default 2048 floor folds
+    small test graphs into one bucket); counters must stay bitwise equal
+    to the event engine in both ring layouts, with per-edge delays and
+    with loss — and the staged bucket layout must actually be multiple
+    buckets, or this test is vacuous."""
+    from p2p_gossip_tpu.models.linkloss import LinkLossModel
+
+    g = pg.barabasi_albert(220, m=3, seed=5)
+    sched = pg.uniform_renewal_schedule(g.n, sim_time=3.0, tick_dt=0.01,
+                                        seed=5)
+    delays = lognormal_delays(g, mean_ticks=2.0, sigma=0.7, max_ticks=4,
+                              seed=5)
+    for ring_mode in ("replicated", "sharded"):
+        for loss in (None, LinkLossModel(0.2, seed=9)):
+            ev = run_event_sim(g, sched, 300, ell_delays=delays, loss=loss)
+            sh = run_sharded_sim(
+                g, sched, 300, _cpu_mesh(4, 2), ell_delays=delays,
+                chunk_size=32, loss=loss, ring_mode=ring_mode,
+                bucket_min_rows=1,
+            )
+            assert sh.equal_counts(ev), (ring_mode, loss)
+            counts = sh.extra["ring"]["degree_buckets"]
+            assert len(counts) == 4  # one group per distinct delay value
+            assert max(counts) > 1, counts  # multi-bucket regime reached
+    # Uniform-delay path too (single group, bucketed).
+    ev = run_event_sim(g, sched, 300)
+    sh = run_sharded_sim(
+        g, sched, 300, _cpu_mesh(4, 2), chunk_size=32, bucket_min_rows=1,
+    )
+    assert sh.equal_counts(ev)
+    assert max(sh.extra["ring"]["degree_buckets"]) > 1
