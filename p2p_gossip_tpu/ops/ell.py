@@ -279,6 +279,8 @@ def bucket_rows_by_count(cnt, block: int, min_rows: int):
     import numpy as np
 
     cnt = np.asarray(cnt, dtype=np.int64)
+    if cnt.size == 0:
+        return []  # empty input partitions into no groups
     level = -(-cnt // block)
     high = level > GEOMETRIC_LEVEL_THRESHOLD
     if high.any():

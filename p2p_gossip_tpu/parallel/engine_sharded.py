@@ -262,6 +262,30 @@ def _stage_ell_args(
             idx_g, msk_g, n_node_shards, block=block,
             min_rows=bucket_min_rows,
         )
+        bucketed_entries = sum(
+            idx_b.shape[0] * idx_b.shape[1] * idx_b.shape[2]
+            for _, idx_b, _ in buckets
+        )
+        cap_full = max(
+            (idx_b.shape[2] for _, idx_b, _ in buckets), default=1
+        )
+        direct_entries = idx_g.shape[0] * min(cap_full, idx_g.shape[1])
+        if bucketed_entries > 0.75 * direct_entries:
+            # Uniform-degree group: bucketing saves <25% of the gather
+            # while adding per-bucket dispatch and a scatter per tick —
+            # measured a 22% sharded-leg regression on the 1M ER mesh
+            # (dmax 1164, mean degree ~1000). Stage the direct
+            # full-width pair instead (bucket_counts 0 = the runner
+            # consumes it without the bucket machinery); columns still
+            # trim to the group's block-rounded max count. Hub-skewed
+            # groups (1M BA: 750x full-cap waste) keep the buckets.
+            cap = min(cap_full, idx_g.shape[1])
+            bucket_counts.append(0)
+            ell_args.extend((
+                np.ascontiguousarray(idx_g[:, :cap]),
+                np.ascontiguousarray(msk_g[:, :cap]),
+            ))
+            continue
         bucket_counts.append(len(buckets))
         for rows_b, idx_b, msk_b in buckets:
             ell_args.extend((rows_b, idx_b, msk_b))
@@ -417,10 +441,33 @@ def build_sharded_runner(
                 (uniform_delay,) if uniform_delay is not None
                 else delay_values
             )
+            def loss_dst_ids(local_rows):
+                # THE global-id convention the loss coin hashes (shared
+                # with the single-device engines): shard row offset +
+                # local row id. One definition for both gather branches.
+                if loss is None:
+                    return None
+                return row_offset + local_rows
+
             acc = jnp.zeros((n_loc, w), dtype=jnp.uint32)
             pos = 0
             for gi, dval in enumerate(group_delays):
                 sl = read_slice(hist, t, dval)
+                if bucket_counts[gi] == 0:
+                    # Direct full-width pair (uniform-degree group —
+                    # bucketing would save <25%, see _stage_ell_args):
+                    # rows are 0..n_loc-1 in order, no scatter needed.
+                    idx_g, msk_g = ell_args[pos: pos + 2]
+                    pos += 2
+                    acc = acc | gather_or_frontier(
+                        sl, t, idx_g, msk_g,
+                        block=max(1, min(block, idx_g.shape[1])),
+                        loss=loss,
+                        dst_ids=loss_dst_ids(
+                            jnp.arange(n_loc, dtype=jnp.int32)
+                        ),
+                    )
+                    continue
                 cat_rows, cat_parts = [], []
                 for _ in range(bucket_counts[gi]):
                     rows_b, idx_b, msk_b = ell_args[pos: pos + 3]
@@ -431,10 +478,7 @@ def build_sharded_runner(
                         sl, t, idx_b, msk_b,
                         block=max(1, min(block, idx_b.shape[1])),
                         loss=loss,
-                        dst_ids=(
-                            row_offset + rows_b
-                            if loss is not None else None
-                        ),
+                        dst_ids=loss_dst_ids(rows_b),
                     )
                     cat_rows.append(rows_b)
                     cat_parts.append(part)
@@ -527,15 +571,17 @@ def build_sharded_runner(
 
     # Per bucket triple: rows (S, R) + idx/mask (S, R, C), all with the
     # shard axis leading — splitting it hands each device its own
-    # (1, ...) slice.
-    ell_specs = sum(
-        (
-            (P(NODES_AXIS, None), P(NODES_AXIS, None, None),
-             P(NODES_AXIS, None, None))
-            for _ in range(sum(bucket_counts))
-        ),
-        (),
-    )
+    # (1, ...) slice. A 0 count is a direct full-width (idx, mask) pair
+    # sharded by rows (see _stage_ell_args).
+    ell_specs: tuple = ()
+    for bc in bucket_counts:
+        if bc == 0:
+            ell_specs += (P(NODES_AXIS, None), P(NODES_AXIS, None))
+        else:
+            ell_specs += (
+                P(NODES_AXIS, None), P(NODES_AXIS, None, None),
+                P(NODES_AXIS, None, None),
+            ) * bc
     mapped = shard_map(
         pass_fn,
         mesh=mesh,
