@@ -255,6 +255,11 @@ def main() -> None:
             else None
         ),
         "ticks": ticks,
+        # Modeled traffic for the whole timed pass, so the profiler's
+        # measured_hbm_bytes can calibrate the model bytes-to-bytes on
+        # one clock (profile_capture.py) instead of via bandwidth ratios
+        # whose denominators differ (device busy time vs bench wall).
+        "modeled_bytes_total": round(bytes_tick * ticks),
     }
     if profile_dir:
         # Tracing adds per-op overhead: mark the row so artifact pickers

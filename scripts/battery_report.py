@@ -16,15 +16,14 @@ import json
 import os
 import sys
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 # Mirror onchip_battery.py's --art-dir resolution (P2P_BATTERY_DIR wins)
 # so a no-arg report reads the same battery_latest.jsonl the battery wrote.
 DEFAULT = os.path.join(
     os.environ.get(
         "P2P_BATTERY_DIR",
-        os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "docs", "artifacts",
-        ),
+        os.path.join(REPO, "docs", "artifacts"),
     ),
     "battery_latest.jsonl",
 )
@@ -183,12 +182,56 @@ def main() -> int:
         ]
         if summaries:
             s = summaries[-1]
+            # The battery record holds the parse as of run time; the
+            # canonical derived artifact is the standalone summary JSON
+            # next to the committed capture, which an offline re-parse
+            # may have corrected (e.g. the 2x include_infeed_outfeed
+            # row double-count fixed 2026-08-01). Prefer it when present.
+            # Key the lookup on the stamp (present in every summary,
+            # even when the capture was too large to commit and
+            # s["capture"] is None); look beside the jsonl being read
+            # first, then the capture's repo-relative path.
+            stamp = s.get("utc_stamp") or ""
+            cap = s.get("capture") or ""
+            candidates = []
+            if stamp:
+                candidates.append(os.path.join(
+                    os.path.dirname(os.path.abspath(path)),
+                    f"profile_{stamp}_summary.json",
+                ))
+            if cap.endswith(".xplane.pb.gz"):
+                candidates.append(os.path.join(
+                    REPO, cap.replace(".xplane.pb.gz", "_summary.json")
+                ))
+            from_file = False
+            for spath in candidates:
+                if os.path.exists(spath):
+                    try:
+                        with open(spath) as f:
+                            loaded = json.load(f)
+                    except (OSError, ValueError):
+                        continue
+                    # Valid JSON that isn't a summary dict (hand-edited,
+                    # future list-of-summaries writer) must fall back,
+                    # not crash md_table.
+                    if isinstance(loaded, dict):
+                        s = loaded
+                        from_file = True
+                        break
             print("## Profiler calibration (measured vs modeled HBM)\n")
+            if not from_file:
+                print(
+                    "(battery-time parse — standalone summary JSON not "
+                    "found; sums may predate offline corrections, e.g. "
+                    "the 2026-08-01 2x row-double-count fix)\n"
+                )
             print(md_table([s], [
                 "bench_metric",
                 "tool", "op_rows", "ops_with_hbm_bw", "total_self_time_us",
                 "measured_hbm_bytes", "measured_hbm_gbps_over_self_time",
-                "modeled_achieved_gbps", "measured_over_modeled", "capture",
+                "modeled_achieved_gbps", "measured_over_modeled",
+                "modeled_bytes_total", "measured_over_modeled_bytes",
+                "capture",
             ]))
             if s.get("error"):
                 print(f"\nparse error: `{s['error']}`" + (
