@@ -1,0 +1,44 @@
+"""Batched Monte-Carlo campaign engine.
+
+The reference study (and SURVEY §5) draws conclusions from *single* runs,
+but gossip coverage time is a random variable — one seed says nothing
+about tail latency. This subsystem runs R independent replicas of the
+synchronous tick engine inside ONE ``jit`` via a leading ``vmap`` axis
+over (seen, hist, counters, generation schedule) and reduces the
+per-replica results to ensemble statistics — the same batching shape that
+makes inference stacks fast, applied to simulation:
+
+- ``batch.campaign`` — replica-set builders and the vmapped engines
+  (coverage campaigns with per-replica coverage-tick capture; gossip
+  campaigns chunked over the share axis);
+- ``batch.stats``    — ensemble reduction: time-to-coverage percentiles
+  (p50/p95/p99), counter confidence intervals, redundancy distributions;
+- ``batch.sweep``    — parameter-grid sweeps over {protocol, p, lossProb,
+  churnProb, fanout} x seeds, one JSON record per cell plus a
+  human-readable campaign report (``scripts/sweep.py`` is the CLI).
+
+The batch axis is a pure throughput lever: replica *i* of a vmapped
+campaign is bitwise-identical (all counter vectors + coverage history) to
+a solo ``engine.sync`` run with the same seed — asserted by the tests.
+"""
+
+from p2p_gossip_tpu.batch.campaign import (
+    CampaignResult,
+    ReplicaSet,
+    flood_replicas,
+    gossip_replicas,
+    run_coverage_campaign,
+    run_gossip_campaign,
+)
+from p2p_gossip_tpu.batch.stats import ensemble_summary, format_campaign_report
+
+__all__ = [
+    "CampaignResult",
+    "ReplicaSet",
+    "flood_replicas",
+    "gossip_replicas",
+    "run_coverage_campaign",
+    "run_gossip_campaign",
+    "ensemble_summary",
+    "format_campaign_report",
+]

@@ -1,0 +1,659 @@
+"""vmap-replicated simulation campaigns on the synchronous tick engine.
+
+One compiled XLA program runs R independent replicas of ``engine.sync``:
+the replica axis is a leading ``vmap`` dimension over (origins, gen_ticks,
+churn intervals) and therefore over every piece of loop state the tick
+body carries (seen bitmask, history ring, counters, coverage history).
+Nothing about the tick semantics changes — the batched kernels ``vmap``
+the SAME ``_tick_body`` the solo engine jits (the bitwise-parity contract
+of ``apply_tick_updates``) inside one shared ``while_loop`` — so replica
+*i* is bitwise-identical to a solo run with the same seed. A replica past
+its own quiescence has an all-zero frontier, making every further update
+an exact identity; the batch runs until the slowest replica converges.
+(``vmap`` over the solo jitted loop would work too, but JAX's batched-
+while transform adds per-element selects on every carried array — the
+shared-loop form measured ~4x cheaper to compile and run.)
+
+What varies per replica (the seed ensemble): the generation schedule
+(origins + gen ticks) and the churn downtime intervals, both sampled
+host-side from the replica's seed with the same stream offsets the CLI
+uses (so ``--seed s`` solo runs reproduce replica ``s`` exactly). What is
+shared across a batch (the cell config): the graph, the delay model, and
+the link-loss model — loss is a static (threshold, seed) pair baked into
+the compiled program; its per-message coins still differ across replicas
+because the hash keys on arrival ticks, which the per-replica schedules
+shift. Per-replica loss seeds would need a traced seed through the gather
+(ROADMAP open item).
+
+Replicas are chunked to a static ``batch_size`` so XLA compiles one
+program regardless of R; padding replicas get the never-fires gen-tick
+sentinel and converge on tick one. With ``mesh``, the replica axis is
+sharded over the existing (shares, nodes) device mesh — replicas are
+embarrassingly parallel, so SPMD partitioning along the batch dimension
+needs no collectives beyond the loop predicate's OR.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from p2p_gossip_tpu.engine.sync import (
+    MIN_CHUNK_SHARES,
+    DeviceGraph,
+    _resolve_block,
+    _tick_body,
+)
+from p2p_gossip_tpu.models.churn import ChurnModel, effective_generated, random_churn
+from p2p_gossip_tpu.models.generation import Schedule, uniform_renewal_schedule
+from p2p_gossip_tpu.models.topology import Graph
+from p2p_gossip_tpu.ops import bitmask
+from p2p_gossip_tpu.utils import logging as p2plog
+from p2p_gossip_tpu.utils.stats import NodeStats
+
+log = p2plog.get_logger("Batch.Campaign")
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaSet:
+    """Host-side per-replica inputs of one campaign cell.
+
+    ``origins``/``gen_ticks`` are (R, S) int32 — every replica padded to a
+    common share count S with the never-fires sentinel (gen_tick ==
+    horizon). ``churn`` stacks each replica's downtime intervals into a
+    pair of (R, N, K) int32 arrays (None = no churn anywhere).
+    """
+
+    n: int
+    origins: np.ndarray
+    gen_ticks: np.ndarray
+    seeds: np.ndarray  # (R,) int64 — provenance of each replica
+    churn: tuple[np.ndarray, np.ndarray] | None = None
+
+    def __post_init__(self):
+        if self.origins.shape != self.gen_ticks.shape or self.origins.ndim != 2:
+            raise ValueError(
+                f"origins/gen_ticks must be matching (R, S) arrays, got "
+                f"{self.origins.shape} and {self.gen_ticks.shape}"
+            )
+        if self.seeds.shape[0] != self.origins.shape[0]:
+            raise ValueError("one seed per replica required")
+
+    @property
+    def num_replicas(self) -> int:
+        return int(self.origins.shape[0])
+
+    @property
+    def shares_per_replica(self) -> int:
+        return int(self.origins.shape[1])
+
+    def replica_schedule(self, r: int, horizon: int) -> Schedule:
+        """Replica ``r``'s schedule with sentinel padding stripped — what a
+        solo engine run of this replica takes."""
+        live = self.gen_ticks[r] < horizon
+        return Schedule(self.n, self.origins[r][live], self.gen_ticks[r][live])
+
+    def replica_churn(self, r: int) -> ChurnModel | None:
+        if self.churn is None:
+            return None
+        return ChurnModel(
+            n=self.n, down_start=self.churn[0][r], down_end=self.churn[1][r]
+        )
+
+
+def _stack_churn(
+    n: int, horizon: int, seeds, churn_prob: float,
+    mean_down_ticks: float, max_outages: int,
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Per-replica churn intervals, sampled with the CLI's seed offset
+    (+7919) so replica seeds reproduce solo ``--churnProb`` runs."""
+    if churn_prob <= 0.0:
+        return None
+    models = [
+        random_churn(
+            n, horizon, outage_prob=churn_prob,
+            mean_down_ticks=mean_down_ticks, max_outages=max_outages,
+            seed=int(s) + 7919,
+        )
+        for s in seeds
+    ]
+    return (
+        np.stack([m.down_start for m in models]),
+        np.stack([m.down_end for m in models]),
+    )
+
+
+def flood_replicas(
+    graph: Graph,
+    shares_per_replica: int,
+    seeds,
+    horizon: int,
+    churn_prob: float = 0.0,
+    mean_down_ticks: float = 10.0,
+    max_outages: int = 1,
+) -> ReplicaSet:
+    """Seed ensemble for the flood coverage-time experiment: each replica
+    floods S shares from seed-sampled random origins at t=0 — the same
+    origin stream as the CLI's ``--floodCoverage`` (``default_rng(seed)
+    .integers(0, n, S)``), so a solo run with the same seed is the exact
+    reference for each replica."""
+    seeds = np.asarray(seeds, dtype=np.int64).reshape(-1)
+    origins = np.stack(
+        [
+            np.random.default_rng(int(s))
+            .integers(0, graph.n, shares_per_replica)
+            .astype(np.int32)
+            for s in seeds
+        ]
+    )
+    gen_ticks = np.zeros_like(origins)
+    return ReplicaSet(
+        n=graph.n, origins=origins, gen_ticks=gen_ticks, seeds=seeds,
+        churn=_stack_churn(
+            graph.n, horizon, seeds, churn_prob, mean_down_ticks, max_outages
+        ),
+    )
+
+
+def gossip_replicas(
+    graph: Graph,
+    sim_time: float,
+    tick_dt: float,
+    seeds,
+    horizon: int,
+    gen_lo: float = 2.0,
+    gen_hi: float = 5.0,
+    churn_prob: float = 0.0,
+    mean_down_ticks: float = 10.0,
+    max_outages: int = 1,
+) -> ReplicaSet:
+    """Seed ensemble for the reference gossip workload: each replica
+    samples its own uniform-renewal generation schedule (the reference's
+    U(genLo, genHi) process). Schedules have different lengths across
+    seeds; all are padded to the longest with the never-fires sentinel."""
+    seeds = np.asarray(seeds, dtype=np.int64).reshape(-1)
+    scheds = [
+        uniform_renewal_schedule(
+            graph.n, sim_time, tick_dt, gen_lo, gen_hi, seed=int(s)
+        )
+        for s in seeds
+    ]
+    s_max = max(s.num_shares for s in scheds)
+    origins = np.zeros((len(scheds), s_max), dtype=np.int32)
+    gen_ticks = np.full((len(scheds), s_max), horizon, dtype=np.int32)
+    for r, sched in enumerate(scheds):
+        origins[r, : sched.num_shares] = sched.origins
+        gen_ticks[r, : sched.num_shares] = sched.gen_ticks
+    return ReplicaSet(
+        n=graph.n, origins=origins, gen_ticks=gen_ticks, seeds=seeds,
+        churn=_stack_churn(
+            graph.n, horizon, seeds, churn_prob, mean_down_ticks, max_outages
+        ),
+    )
+
+
+@dataclasses.dataclass
+class CampaignResult:
+    """Per-replica outputs of one campaign cell, plus provenance.
+
+    ``coverage`` is (R, horizon, S) per-tick node counts (None for gossip
+    campaigns, which track counters only); counter arrays are (R, N).
+    """
+
+    n: int
+    seeds: np.ndarray
+    generated: np.ndarray
+    received: np.ndarray
+    sent: np.ndarray
+    degree: np.ndarray
+    horizon: int
+    wall_s: float
+    batch_size: int
+    coverage: np.ndarray | None = None
+
+    @property
+    def num_replicas(self) -> int:
+        return int(self.seeds.shape[0])
+
+    def replica_stats(self, r: int) -> NodeStats:
+        """Replica ``r``'s counters as a NodeStats — the bridge into
+        ``utils.analysis`` (redundancy, conservation checks)."""
+        received = self.received[r]
+        return NodeStats(
+            generated=self.generated[r],
+            received=received,
+            forwarded=received.copy(),
+            sent=self.sent[r],
+            processed=self.generated[r] + received,
+            degree=self.degree,
+        )
+
+    def totals_per_replica(self) -> dict[str, np.ndarray]:
+        """(R,) totals of each counter — the samples the ensemble CIs and
+        redundancy distributions in ``batch.stats`` reduce over."""
+        return {
+            "generated": self.generated.sum(axis=1),
+            "received": self.received.sum(axis=1),
+            "sent": self.sent.sum(axis=1),
+            "processed": (self.generated + self.received).sum(axis=1),
+        }
+
+
+def _replica_sharding(mesh, ndim: int):
+    """NamedSharding placing the leading replica axis across every mesh
+    device (replicas are embarrassingly parallel — pure data parallelism
+    over the flattened (shares, nodes) mesh)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from p2p_gossip_tpu.parallel.mesh import NODES_AXIS, SHARES_AXIS
+
+    return NamedSharding(
+        mesh, P((SHARES_AXIS, NODES_AXIS), *([None] * (ndim - 1)))
+    )
+
+
+def _shard_batch(mesh, arrays):
+    """Place each (B, ...) array with its replica axis sharded over the
+    mesh. B must divide by the device count (the batch padding in
+    ``_iter_batches`` guarantees it when a mesh is passed)."""
+    if mesh is None:
+        return arrays
+    return tuple(
+        None
+        if a is None
+        else jax.device_put(a, _replica_sharding(mesh, a.ndim))
+        for a in arrays
+    )
+
+
+def _batched_tick(dg, block, t, seen, hist, received, sent,
+                  origins_b, gen_ticks_b, churn_b, slots, loss):
+    """One global tick over the whole (B, ...) replica batch: ``vmap`` of
+    the solo engine's ``_tick_body`` (which carries the shared counter
+    semantics) over the replica axis, at a COMMON tick counter ``t``.
+
+    The common counter is what keeps the compiled loop cheap: a vmap over
+    the solo ``while_loop`` would trigger JAX's batched-while transform
+    (per-element select on every carried array, measured ~4x the compile
+    and run cost at R=8). Instead ONE while_loop carries the batched
+    state; a replica past its own quiescence simply has an all-zero
+    frontier, so every update it computes is the identity — bitwise, not
+    approximately — and the batch runs until the slowest replica settles.
+    """
+
+    def tick_one(seen, hist, received, sent, origins, gen_ticks, churn):
+        _, seen, hist, received, sent = _tick_body(
+            dg, block, (t, seen, hist, received, sent), origins, slots,
+            gen_ticks, churn, loss,
+        )
+        return seen, hist, received, sent
+
+    if churn_b is None:
+        return jax.vmap(
+            lambda se, h, r, sn, o, g: tick_one(se, h, r, sn, o, g, None)
+        )(seen, hist, received, sent, origins_b, gen_ticks_b)
+    return jax.vmap(tick_one)(
+        seen, hist, received, sent, origins_b, gen_ticks_b, churn_b
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("chunk_size", "horizon", "block", "loss", "coverage_slots"),
+)
+def _run_coverage_batch(
+    dg: DeviceGraph,
+    origins_b: jnp.ndarray,    # (B, S) int32
+    gen_ticks_b: jnp.ndarray,  # (B, S) int32
+    churn_b=None,              # optional ((B, N, K), (B, N, K))
+    *,
+    chunk_size: int,
+    horizon: int,
+    block: int,
+    loss: tuple | None = None,
+    coverage_slots: int | None = None,
+):
+    """Coverage-recording replica batch — the campaign counterpart of
+    ``engine.sync._run_chunk_coverage`` with a leading replica axis on
+    every piece of loop state. Pallas coverage stays off: the kernel's
+    batching rule is unvalidated on hardware (ROADMAP open item)."""
+    n, w = dg.n, bitmask.num_words(chunk_size)
+    b = origins_b.shape[0]
+    cov_slots = chunk_size if coverage_slots is None else coverage_slots
+    cov_w = bitmask.num_words(cov_slots)
+    slots = jnp.arange(chunk_size, dtype=jnp.int32)
+    # Global pending-generations bound: any replica pending <=> t <= max.
+    last_gen = jnp.max(jnp.where(gen_ticks_b < horizon, gen_ticks_b, 0))
+
+    def cov_delta_of(newly_out):
+        # scan-form reduction: bitwise-equal to coverage_per_slot, ~2x
+        # cheaper to compile inside this while body (ops/bitmask.py).
+        return jax.vmap(
+            lambda rows: bitmask.coverage_per_slot_scan(rows, cov_slots)
+        )(newly_out[:, :, :cov_w])
+
+    state = (
+        jnp.zeros((), dtype=jnp.int32),
+        jnp.zeros((b, n, w), dtype=jnp.uint32),
+        jnp.zeros((b, dg.ring_size, n, w), dtype=jnp.uint32),
+        jnp.zeros((b, n), dtype=jnp.int32),
+        jnp.zeros((b, n), dtype=jnp.int32),
+        jnp.zeros((b, cov_slots), dtype=jnp.int32),
+        jnp.zeros((b, horizon, cov_slots), dtype=jnp.int32),
+    )
+
+    def cond(full_state):
+        t, _, hist, _, _, _, _ = full_state
+        return (t < horizon) & (jnp.any(hist != 0) | (t <= last_gen))
+
+    def step(full_state):
+        t, seen, hist, received, sent, cov_run, cov_hist = full_state
+        seen, hist, received, sent = _batched_tick(
+            dg, block, t, seen, hist, received, sent,
+            origins_b, gen_ticks_b, churn_b, slots, loss,
+        )
+        cov_run = cov_run + cov_delta_of(hist[:, jnp.mod(t, dg.ring_size)])
+        cov_hist = jax.lax.dynamic_update_slice(
+            cov_hist, cov_run[:, None, :], (0, t, 0)
+        )
+        return (t + 1, seen, hist, received, sent, cov_run, cov_hist)
+
+    t, seen, _, received, sent, cov_run, cov_hist = jax.lax.while_loop(
+        cond, step, state
+    )
+    # Rows past global quiescence hold the (monotone, constant) final
+    # coverage — identical to the solo engine's per-replica fill, since a
+    # replica's cov_run stops changing at ITS quiescence.
+    ticks = jnp.arange(horizon, dtype=jnp.int32)[None, :, None]
+    coverage = jnp.where(ticks >= t, cov_run[:, None, :], cov_hist)
+    return seen, received, sent, coverage
+
+
+@functools.partial(
+    jax.jit, static_argnames=("chunk_size", "horizon", "block", "loss")
+)
+def _run_while_batch(
+    dg: DeviceGraph,
+    origins_b: jnp.ndarray,
+    gen_ticks_b: jnp.ndarray,
+    t_start: jnp.ndarray,   # scalar int32 — min live gen tick of the batch
+    last_gen: jnp.ndarray,  # scalar int32 — max live gen tick of the batch
+    churn_b=None,
+    *,
+    chunk_size: int,
+    horizon: int,
+    block: int,
+    loss: tuple | None = None,
+):
+    """Counter-only replica batch (no coverage history) — the gossip-
+    campaign counterpart of ``engine.sync._run_chunk_while``. The tick
+    counter is global: ticks before a replica's own first generation are
+    identity updates (empty frontier, no firing gens), exactly as the
+    solo engine's earlier ``t_start`` would skip them."""
+    n, w = dg.n, bitmask.num_words(chunk_size)
+    b = origins_b.shape[0]
+    slots = jnp.arange(chunk_size, dtype=jnp.int32)
+    state = (
+        t_start,
+        jnp.zeros((b, n, w), dtype=jnp.uint32),
+        jnp.zeros((b, dg.ring_size, n, w), dtype=jnp.uint32),
+        jnp.zeros((b, n), dtype=jnp.int32),
+        jnp.zeros((b, n), dtype=jnp.int32),
+    )
+
+    def cond(state):
+        t, _, hist, _, _ = state
+        return (t < horizon) & (jnp.any(hist != 0) | (t <= last_gen))
+
+    def body(state):
+        t, seen, hist, received, sent = state
+        seen, hist, received, sent = _batched_tick(
+            dg, block, t, seen, hist, received, sent,
+            origins_b, gen_ticks_b, churn_b, slots, loss,
+        )
+        return (t + 1, seen, hist, received, sent)
+
+    _, seen, _, received, sent = jax.lax.while_loop(cond, body, state)
+    return seen, received, sent
+
+
+def _iter_batches(replicas: ReplicaSet, batch_size: int, horizon: int):
+    """Slice the replica axis into static-size batches. The last batch is
+    padded with sentinel replicas (gen_ticks == horizon everywhere): they
+    generate nothing, converge immediately under the batched while_loop,
+    and their rows are dropped on the host side."""
+    r_total = replicas.num_replicas
+    for lo in range(0, r_total, batch_size):
+        hi = min(lo + batch_size, r_total)
+        live = hi - lo
+        origins = replicas.origins[lo:hi]
+        gen_ticks = replicas.gen_ticks[lo:hi]
+        churn = (
+            None
+            if replicas.churn is None
+            else (replicas.churn[0][lo:hi], replicas.churn[1][lo:hi])
+        )
+        if live < batch_size:
+            pad = batch_size - live
+            origins = np.concatenate(
+                [origins, np.zeros((pad, origins.shape[1]), dtype=np.int32)]
+            )
+            gen_ticks = np.concatenate(
+                [gen_ticks,
+                 np.full((pad, gen_ticks.shape[1]), horizon, dtype=np.int32)]
+            )
+            if churn is not None:
+                zpad = np.zeros((pad,) + churn[0].shape[1:], dtype=np.int32)
+                churn = (
+                    np.concatenate([churn[0], zpad]),
+                    np.concatenate([churn[1], zpad.copy()]),
+                )
+        yield lo, live, origins, gen_ticks, churn
+
+
+def _resolve_batch(replicas: ReplicaSet, batch_size: int | None, mesh) -> int:
+    if batch_size is None:
+        batch_size = replicas.num_replicas
+    if batch_size < 1:
+        raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+    if mesh is not None:
+        n_dev = int(np.prod(mesh.devices.shape))
+        if batch_size % n_dev:
+            # Round up so the replica axis divides the device count —
+            # sentinel padding absorbs the overhang.
+            batch_size += n_dev - batch_size % n_dev
+    return batch_size
+
+
+def _campaign_generated(
+    replicas: ReplicaSet, horizon: int
+) -> np.ndarray:
+    """(R, N) effective per-node generated counters (churn-aware) — pure
+    host arithmetic shared by both campaign flavors."""
+    return np.stack(
+        [
+            effective_generated(
+                replicas.replica_schedule(r, horizon), horizon,
+                replicas.replica_churn(r),
+            )
+            for r in range(replicas.num_replicas)
+        ]
+    )
+
+
+def run_coverage_campaign(
+    graph: Graph,
+    replicas: ReplicaSet,
+    horizon: int,
+    ell_delays: np.ndarray | None = None,
+    constant_delay: int = 1,
+    loss=None,
+    batch_size: int | None = None,
+    chunk_size: int | None = None,
+    block: int | None = None,
+    device_graph: DeviceGraph | None = None,
+    mesh=None,
+) -> CampaignResult:
+    """Coverage-recording campaign: every replica runs the flood/coverage
+    experiment (``engine.sync.run_flood_coverage`` semantics — arbitrary
+    gen ticks allowed) and records its per-tick coverage history.
+
+    Returns per-replica counters plus a (R, horizon, S) coverage tensor.
+    Bitwise contract: row r equals the solo engine's output for replica
+    r's schedule/churn under the same loss model — the batch axis is a
+    throughput lever only. Results are also invariant to the share pad
+    width (padded slots carry the never-fires sentinel), which is what
+    lets ``chunk_size=None`` pick a platform-aware default: on TPU the
+    solo engine's MIN_CHUNK_SHARES lane pad (full 128-lane tiles), on
+    CPU a packed pad near the actual share count — at S=4, R=32, N=1024
+    the packed pad measured ~20x faster end-to-end (the replica axis
+    supplies the parallelism the lane pad existed to buy).
+    """
+    s = replicas.shares_per_replica
+    dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
+    if chunk_size is None:
+        on_tpu = any(d.platform == "tpu" for d in dg.ell_idx.devices())
+        floor = MIN_CHUNK_SHARES if on_tpu else min(MIN_CHUNK_SHARES, 128)
+    else:
+        floor = chunk_size
+    chunk = bitmask.num_words(max(s, floor)) * bitmask.WORD_BITS
+    block = _resolve_block(dg, block)
+    loss_cfg = loss.static_cfg if loss is not None else None
+    batch_size = _resolve_batch(replicas, batch_size, mesh)
+    r_total = replicas.num_replicas
+    log.info(
+        f"coverage campaign: {r_total} replicas x {graph.n} nodes x {s} "
+        f"shares, batch {batch_size}, horizon {horizon}"
+        + (f", mesh {mesh.devices.shape}" if mesh is not None else "")
+    )
+
+    received = np.zeros((r_total, graph.n), dtype=np.int64)
+    sent = np.zeros((r_total, graph.n), dtype=np.int64)
+    coverage = np.zeros((r_total, horizon, s), dtype=np.int32)
+    t0 = time.perf_counter()
+    for lo, live, origins, gen_ticks, churn in _iter_batches(
+        replicas, batch_size, horizon
+    ):
+        pad_o = np.zeros((batch_size, chunk), dtype=np.int32)
+        pad_g = np.full((batch_size, chunk), horizon, dtype=np.int32)
+        pad_o[:, :s] = origins
+        pad_g[:, :s] = gen_ticks
+        pad_o, pad_g, *churn_parts = _shard_batch(
+            mesh,
+            (pad_o, pad_g) + (churn if churn is not None else (None, None)),
+        )
+        churn_dev = (
+            None if churn_parts[0] is None else tuple(churn_parts)
+        )
+        _, r, snt, cov = _run_coverage_batch(
+            dg, jnp.asarray(pad_o), jnp.asarray(pad_g), churn_dev,
+            chunk_size=chunk, horizon=horizon, block=block, loss=loss_cfg,
+            coverage_slots=s,
+        )
+        received[lo : lo + live] = np.asarray(r)[:live]
+        sent[lo : lo + live] = np.asarray(snt)[:live]
+        coverage[lo : lo + live] = np.asarray(cov)[:live, :, :s]
+    wall = time.perf_counter() - t0
+
+    return CampaignResult(
+        n=graph.n,
+        seeds=replicas.seeds,
+        generated=_campaign_generated(replicas, horizon),
+        received=received,
+        sent=sent,
+        degree=np.asarray(dg.degree, dtype=np.int64),
+        horizon=horizon,
+        wall_s=wall,
+        batch_size=batch_size,
+        coverage=coverage,
+    )
+
+
+def run_gossip_campaign(
+    graph: Graph,
+    replicas: ReplicaSet,
+    horizon: int,
+    ell_delays: np.ndarray | None = None,
+    constant_delay: int = 1,
+    loss=None,
+    batch_size: int | None = None,
+    chunk_size: int = 4096,
+    block: int | None = None,
+    device_graph: DeviceGraph | None = None,
+    mesh=None,
+) -> CampaignResult:
+    """Counter-only campaign of the full gossip workload: R replicas of
+    the reference simulation (per-replica generation schedules, arbitrary
+    share counts) chunked over the share axis like the solo engine —
+    counters are additive across chunks per replica. Per-replica counters
+    are bitwise-identical to solo ``run_sync_sim`` with the same seed."""
+    s_max = replicas.shares_per_replica
+    chunk = min(chunk_size, max(MIN_CHUNK_SHARES, s_max))
+    chunk = bitmask.num_words(chunk) * bitmask.WORD_BITS
+    dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
+    block = _resolve_block(dg, block)
+    loss_cfg = loss.static_cfg if loss is not None else None
+    batch_size = _resolve_batch(replicas, batch_size, mesh)
+    r_total = replicas.num_replicas
+    n_chunks = max(1, -(-s_max // chunk))
+    log.info(
+        f"gossip campaign: {r_total} replicas x {graph.n} nodes, up to "
+        f"{s_max} shares in {n_chunks} chunk(s) of {chunk}, batch "
+        f"{batch_size}, horizon {horizon}"
+    )
+
+    received = np.zeros((r_total, graph.n), dtype=np.int64)
+    sent = np.zeros((r_total, graph.n), dtype=np.int64)
+    t0 = time.perf_counter()
+    for lo, live, origins, gen_ticks, churn in _iter_batches(
+        replicas, batch_size, horizon
+    ):
+        for ci in range(n_chunks):
+            o_slice = origins[:, ci * chunk : (ci + 1) * chunk]
+            g_slice = gen_ticks[:, ci * chunk : (ci + 1) * chunk]
+            if not (g_slice < horizon).any():
+                continue
+            pad_o = np.zeros((batch_size, chunk), dtype=np.int32)
+            pad_g = np.full((batch_size, chunk), horizon, dtype=np.int32)
+            pad_o[:, : o_slice.shape[1]] = o_slice
+            pad_g[:, : g_slice.shape[1]] = g_slice
+            # Global loop bounds: first and last live gen tick across the
+            # batch. Replicas whose own window is narrower just execute
+            # identity ticks at the edges (empty frontier, no gens).
+            live_ticks = pad_g[pad_g < horizon]
+            t_start = np.int32(live_ticks.min())
+            last_gen = np.int32(live_ticks.max())
+            pad_o, pad_g, *churn_parts = _shard_batch(
+                mesh,
+                (pad_o, pad_g) + (churn if churn is not None else (None, None)),
+            )
+            churn_dev = (
+                None if churn_parts[0] is None else tuple(churn_parts)
+            )
+            _, r, snt = _run_while_batch(
+                dg, jnp.asarray(pad_o), jnp.asarray(pad_g),
+                jnp.asarray(t_start), jnp.asarray(last_gen), churn_dev,
+                chunk_size=chunk, horizon=horizon, block=block, loss=loss_cfg,
+            )
+            received[lo : lo + live] += np.asarray(r, dtype=np.int64)[:live]
+            sent[lo : lo + live] += np.asarray(snt, dtype=np.int64)[:live]
+    wall = time.perf_counter() - t0
+
+    return CampaignResult(
+        n=graph.n,
+        seeds=replicas.seeds,
+        generated=_campaign_generated(replicas, horizon),
+        received=received,
+        sent=sent,
+        degree=np.asarray(dg.degree, dtype=np.int64),
+        horizon=horizon,
+        wall_s=wall,
+        batch_size=batch_size,
+        coverage=None,
+    )

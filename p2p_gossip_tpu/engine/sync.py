@@ -804,6 +804,9 @@ def run_flood_coverage(
 def time_to_coverage(coverage: np.ndarray, n: int, fraction: float = 0.99):
     """First tick at which each share reaches ``fraction`` of nodes (-1 if
     never). coverage: (T, S)."""
+    if coverage.shape[0] == 0:
+        # Zero-tick history: argmax over an empty axis raises in numpy.
+        return np.full(coverage.shape[1], -1, dtype=np.int64)
     target = int(np.ceil(fraction * n))
     hit = coverage >= target
     first = np.where(hit.any(axis=0), hit.argmax(axis=0), -1)

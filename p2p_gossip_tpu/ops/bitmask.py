@@ -74,6 +74,29 @@ def combine_u64(lo: jnp.ndarray, hi: jnp.ndarray):
     )
 
 
+def coverage_per_slot_scan(seen: jnp.ndarray, n_slots: int) -> jnp.ndarray:
+    """``coverage_per_slot`` with the 32 per-bit reductions rolled into a
+    ``lax.scan`` — bitwise-identical counts (integer sums in the same
+    order), but the loop body compiles once instead of unrolling 32
+    reduction ops into the caller's graph. Used by the batch campaign
+    kernels, whose while-loop body is compile-cost sensitive (the scan
+    form measured ~2x faster cold compile at campaign shapes with no
+    warm-run regression); the unrolled form remains the oracle and the
+    solo engines' default, where XLA's fusion of the open-coded chain is
+    the validated-on-chip path."""
+    n_words = seen.shape[-1]
+
+    def body(_, b):
+        return None, jnp.sum(
+            ((seen >> b) & jnp.uint32(1)).astype(jnp.int32), axis=0
+        )
+
+    _, counts = lax.scan(
+        body, None, jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    )  # (32, W): bit b of word w -> slot w*32 + b
+    return counts.T.reshape(n_words * WORD_BITS)[:n_slots]
+
+
 def coverage_per_slot(seen: jnp.ndarray, n_slots: int) -> jnp.ndarray:
     """Per-share coverage: (N, W) seen-bitmask -> (S,) int32 node counts.
 

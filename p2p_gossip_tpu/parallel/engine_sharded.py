@@ -43,7 +43,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from p2p_gossip_tpu.parallel.mesh import shard_map
 
 from p2p_gossip_tpu.engine.sync import (
     apply_tick_updates,

@@ -47,7 +47,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from p2p_gossip_tpu.parallel.mesh import shard_map
 
 from p2p_gossip_tpu.engine.sync import MIN_CHUNK_SHARES
 from p2p_gossip_tpu.models.churn import effective_generated, up_mask_jnp

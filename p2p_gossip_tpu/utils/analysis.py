@@ -80,7 +80,12 @@ def propagation_latency(
             raise ValueError(f"fractions must be in (0, 1], got {f}")
         target = int(np.ceil(f * n))
         hit = coverage >= target
-        first = np.where(hit.any(axis=0), hit.argmax(axis=0), -1)
+        if horizon == 0:
+            # Zero-tick history: argmax over an empty axis raises in
+            # numpy; semantically nothing ever reached any target.
+            first = np.full(s, -1, dtype=np.int64)
+        else:
+            first = np.where(hit.any(axis=0), hit.argmax(axis=0), -1)
         lat = first.astype(np.int64) - gen
         latency[f] = np.where(first >= 0, np.maximum(lat, 0), -1)
     return PropagationReport(n=n, fractions=tuple(fractions), latency=latency)

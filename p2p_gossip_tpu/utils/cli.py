@@ -236,6 +236,21 @@ def build_parser() -> argparse.ArgumentParser:
         "time-to-99%%-coverage (tpu and sharded backends)",
     )
     p.add_argument(
+        "--replicas", type=int, default=1, metavar="R",
+        help="Monte-Carlo campaign: run R seed-ensemble replicas of the "
+        "simulation inside one jit (batch/campaign.py) and report "
+        "ensemble statistics (ttc percentiles, counter CIs) instead of "
+        "one run's numbers. Replica r uses seed (--seed + r); --backend "
+        "tpu --protocol push only (with or without --floodCoverage)",
+    )
+    p.add_argument(
+        "--sweep", type=str, default="", metavar="SPEC.json",
+        help="Run a campaign sweep from a JSON grid spec (batch/sweep.py: "
+        "axes over protocol/p/lossProb/churnProb/fanout x seeds), "
+        "emitting one JSON line per cell plus a campaign report. "
+        "Ignores the single-run flags; see examples/sweep_small.json",
+    )
+    p.add_argument(
         "--coverageFraction", type=float, default=0.99,
         help="Coverage fraction reported by --floodCoverage (default 0.99)",
     )
@@ -432,6 +447,115 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
     return 0
 
 
+def _run_campaign_cli(args, g, horizon, delays, loss) -> int:
+    """--replicas R: a seed-ensemble campaign in one jit. Replica r's
+    schedule and churn derive from seed (--seed + r) with the solo CLI's
+    stream offsets, so any single replica is reproducible as a solo run;
+    the link-loss model is drawn once from the base seed (a campaign-
+    level config, like the graph). Reports ensemble statistics — the
+    distribution a single-seed run cannot show."""
+    import json
+
+    from p2p_gossip_tpu.batch.campaign import (
+        flood_replicas,
+        gossip_replicas,
+        run_coverage_campaign,
+        run_gossip_campaign,
+    )
+    from p2p_gossip_tpu.batch.stats import ensemble_summary
+
+    seeds = [args.seed + r for r in range(args.replicas)]
+    churn_kw = dict(
+        churn_prob=args.churnProb,
+        mean_down_ticks=max(args.churnDowntime / (args.Latency / 1000.0), 1.0),
+        max_outages=args.churnOutages,
+    )
+    if args.floodCoverage:
+        replicas = flood_replicas(
+            g, args.floodCoverage, seeds, horizon, **churn_kw
+        )
+        result = run_coverage_campaign(
+            g, replicas, horizon, ell_delays=delays, loss=loss,
+            block=args.degreeBlock or None,
+        )
+    else:
+        replicas = gossip_replicas(
+            g, args.simTime, args.Latency / 1000.0, seeds, horizon,
+            gen_lo=args.genLo, gen_hi=args.genHi, **churn_kw,
+        )
+        result = run_gossip_campaign(
+            g, replicas, horizon, ell_delays=delays, loss=loss,
+            chunk_size=args.chunkSize, block=args.degreeBlock or None,
+        )
+    summary = ensemble_summary(result, args.coverageFraction)
+
+    kind = (
+        f"{args.floodCoverage} flood shares"
+        if args.floodCoverage
+        else "gossip schedule"
+    )
+    print(
+        f"=== Campaign: {args.replicas} replicas x {kind}, {g.n} nodes ==="
+    )
+    ttc = summary.get("ttc")
+    if ttc is not None:
+        ticks = ttc.get("ticks")
+        if ticks:
+            tick_ms = args.Latency
+            print(
+                f"Time to {ttc['fraction']:.0%} coverage: mean "
+                f"{ticks['mean']:.1f} / p50 {ticks['p50']:g} / p95 "
+                f"{ticks['p95']:g} / p99 {ticks['p99']:g} ticks "
+                f"(p99 {ticks['p99'] * tick_ms:g} ms); "
+                f"{ttc['reached'] * 100:.1f}% of replica-shares reached"
+            )
+        else:
+            print(
+                f"Time to {ttc['fraction']:.0%} coverage: no replica-share "
+                f"reached within {horizon} ticks"
+            )
+    for name in ("processed", "received", "sent"):
+        c = summary["counters"][name]
+        ci = c["ci95"]
+        print(
+            f"Total {name} per replica: mean {c['mean']:.1f}"
+            + (f" (95% CI {ci[0]:.1f}-{ci[1]:.1f})" if ci else "")
+        )
+    red = summary["redundancy"]["sends_per_delivery"]
+    if red:
+        print(
+            f"Redundancy: {red['mean']:.2f} sends per delivery "
+            f"(p95 {red['p95']:.2f} across replicas)"
+        )
+    print(
+        f"Campaign wall {result.wall_s:.3f}s (one jit, batch "
+        f"{result.batch_size}; "
+        f"{summary['counters']['processed']['mean'] * args.replicas / max(result.wall_s, 1e-9):.3g} "
+        "node-updates/s)"
+    )
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "config": {
+                        "numNodes": g.n,
+                        "edges": int(g.num_edges),
+                        "protocol": args.protocol,
+                        "backend": args.backend,
+                        "replicas": args.replicas,
+                        "floodCoverage": args.floodCoverage,
+                        "lossProb": args.lossProb,
+                        "churnProb": args.churnProb,
+                        "Latency": args.Latency,
+                        "seed": args.seed,
+                    },
+                    "summary": summary,
+                }
+            )
+        )
+    return 0
+
+
 def run(argv=None) -> int:
     args = build_parser().parse_args(argv)
     tick_dt = args.Latency / 1000.0
@@ -450,6 +574,32 @@ def run(argv=None) -> int:
             return 2
     p2plog.set_time_resolution(tick_dt)
     horizon = int(round(args.simTime / tick_dt))
+
+    if args.sweep:
+        import json
+        import os
+
+        if not os.path.exists(args.sweep):
+            print(f"error: --sweep {args.sweep} not found", file=sys.stderr)
+            return 2
+        with open(args.sweep, encoding="utf-8") as f:
+            try:
+                spec = json.load(f)
+            except json.JSONDecodeError as e:
+                print(f"error: --sweep {args.sweep}: {e}", file=sys.stderr)
+                return 2
+        from p2p_gossip_tpu.batch.stats import format_campaign_report
+        from p2p_gossip_tpu.batch.sweep import run_sweep
+
+        try:
+            records = run_sweep(
+                spec, emit=lambda rec: print(json.dumps(rec), flush=True)
+            )
+        except ValueError as e:
+            print(f"error: --sweep: {e}", file=sys.stderr)
+            return 2
+        print(format_campaign_report(records), end="", file=sys.stderr)
+        return 0
 
     # Fingerprint of every flag that determines the built topology: a cache
     # hit with different parameters is an error, not a silent reuse (same
@@ -803,6 +953,38 @@ def run(argv=None) -> int:
         )
         return 2
 
+    if args.replicas < 1:
+        print(
+            f"error: --replicas must be >= 1, got {args.replicas}",
+            file=sys.stderr,
+        )
+        return 2
+    if args.replicas > 1:
+        # The campaign engine vmaps the single-device sync flood path;
+        # partnered protocols and the other backends run ensembles via
+        # the sweep runner (--sweep) until they grow a vmap axis.
+        if args.backend != "tpu" or args.protocol != "push":
+            print(
+                "error: --replicas requires --backend tpu --protocol push "
+                "(use --sweep for partnered-protocol ensembles)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.checkpoint or args.anim:
+            print(
+                "error: --replicas does not support --checkpoint/--anim "
+                "(per-replica artifacts are a sweep-runner concern)",
+                file=sys.stderr,
+            )
+            return 2
+        if not args.floodCoverage and args.genModel != "uniform":
+            print(
+                "error: --replicas without --floodCoverage supports "
+                "--genModel uniform only",
+                file=sys.stderr,
+            )
+            return 2
+
     if args.floodCoverage:
         if args.floodCoverage < 0:
             print(
@@ -824,6 +1006,8 @@ def run(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
+        if args.replicas > 1:
+            return _run_campaign_cli(args, g, horizon, delays, loss)
         return _run_flood_coverage_cli(args, g, horizon, delays, churn, loss)
 
     if (
@@ -854,6 +1038,9 @@ def run(argv=None) -> int:
         if err is not None:
             print(f"error: {err}", file=sys.stderr)
             return 2
+
+    if args.replicas > 1:
+        return _run_campaign_cli(args, g, horizon, delays, loss)
 
     t0 = time.perf_counter()
     if args.protocol in ("pushpull", "pull", "pushk") and args.backend == "sharded":

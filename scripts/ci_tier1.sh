@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Tier-1 gate as one command — the EXACT verify line from ROADMAP.md,
+# plus a pre-flight check that the `slow` marker is registered (an
+# unregistered marker makes `-m 'not slow'` silently rely on pytest's
+# default-warn behavior; registration lives in pyproject.toml).
+#
+#   ./scripts/ci_tier1.sh
+#
+# Exit code is pytest's. DOTS_PASSED echoes the passed-dot count the
+# driver greps for.
+set -u
+cd "$(dirname "$0")/.."
+
+# Marker registration check: `pytest --markers` must list `slow`.
+if ! JAX_PLATFORMS=cpu python -m pytest --markers -p no:cacheprovider 2>/dev/null \
+    | grep -q "^@pytest.mark.slow:"; then
+  echo "ci_tier1: FAIL — 'slow' marker is not registered (pyproject.toml" \
+       "[tool.pytest.ini_options] markers)" >&2
+  exit 1
+fi
+
+# The tier-1 verify line, verbatim from ROADMAP.md.
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

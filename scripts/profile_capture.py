@@ -292,6 +292,16 @@ def main() -> int:
         summary.update(summarize_trace(pb))
     except Exception as e:  # parse failure must not lose the capture
         summary["error"] = f"{type(e).__name__}: {e}"
+        # Summary-row contract: the aggregate fields are PRESENT with
+        # explicit zeros when the parser cannot run at all (e.g. an
+        # image without xprof), so consumers read "no measured data"
+        # from ops_with_hbm_bw/error instead of hitting missing keys —
+        # the same shape a CPU trace with no device-plane rows produces.
+        summary.setdefault("op_rows", 0)
+        summary.setdefault("ops_with_hbm_bw", 0)
+        summary.setdefault("total_self_time_us", 0)
+        summary.setdefault("measured_hbm_bytes", 0)
+        summary.setdefault("measured_mem_bytes", 0)
 
     # Calibration, two ways: a bandwidth ratio (measured bytes over the
     # trace's busy time vs the bench's modeled-bytes-over-wall), and —

@@ -161,3 +161,64 @@ def test_nodestats_add_preserves_peer_extra():
     stotal = sa + sb
     assert stotal.extra["peer_extra"] == 1
     stotal.check_conservation()
+
+
+def test_propagation_latency_empty_coverage_history():
+    """Zero-horizon history (0, S): nothing ever reached, every latency
+    -1, summary stays NaN-free."""
+    cov = np.zeros((0, 3), dtype=np.int64)
+    rep = propagation_latency(cov, n=10, fractions=(0.5, 1.0))
+    np.testing.assert_array_equal(rep.latency[0.5], [-1, -1, -1])
+    s = rep.summary(1.0)
+    assert s == {"median": -1.0, "p95": -1.0, "max": -1.0, "reached": 0.0}
+
+
+def test_propagation_latency_zero_shares():
+    """S=0 (empty share axis): empty latency arrays, reached 0.0."""
+    cov = np.zeros((5, 0), dtype=np.int64)
+    rep = propagation_latency(cov, n=10)
+    for f in rep.fractions:
+        assert rep.latency[f].shape == (0,)
+    assert rep.summary(0.99)["reached"] == 0.0
+    # The report renders for an empty ensemble too.
+    assert "coverage" in format_propagation_report(rep)
+
+
+def test_propagation_latency_saturated_from_tick_zero():
+    """All-ticks-saturated history (coverage == n everywhere): latency 0
+    at every fraction — gen-tick subtraction must not go negative."""
+    cov = np.full((4, 2), 7, dtype=np.int64)
+    rep = propagation_latency(
+        cov, n=7, gen_ticks=np.array([0, 2]), fractions=(0.5, 1.0)
+    )
+    np.testing.assert_array_equal(rep.latency[1.0], [0, 0])
+    s = rep.summary(1.0)
+    assert s["median"] == 0.0 and s["reached"] == 1.0
+
+
+def test_propagation_latency_rejects_bad_fraction():
+    cov = np.zeros((2, 1), dtype=np.int64)
+    import pytest
+
+    with pytest.raises(ValueError, match="fractions"):
+        propagation_latency(cov, n=4, fractions=(0.0,))
+    with pytest.raises(ValueError, match="fractions"):
+        propagation_latency(cov, n=4, fractions=(1.5,))
+
+
+def test_message_redundancy_nothing_delivered():
+    """Zero deliveries: sends_per_delivery is None (strict JSON), wasted
+    fraction accounts all sends as waste; zero sends wastes nothing."""
+    from p2p_gossip_tpu.utils.stats import NodeStats
+
+    z = np.zeros(3, dtype=np.int64)
+    sent = np.array([5, 0, 0], dtype=np.int64)
+    stats = NodeStats(
+        generated=z.copy(), received=z.copy(), forwarded=z.copy(),
+        sent=sent, processed=z.copy(), degree=np.ones(3, dtype=np.int64),
+    )
+    red = message_redundancy(stats)
+    assert red["sends_per_delivery"] is None
+    assert red["wasted_fraction"] == 1.0
+    stats.sent = z.copy()
+    assert message_redundancy(stats)["wasted_fraction"] == 0.0

@@ -1,0 +1,291 @@
+"""Batched Monte-Carlo campaign engine tests.
+
+The load-bearing contract: replica *i* of a vmapped campaign is
+bitwise-identical to a solo sync-engine run with the same seed — all
+counter vectors AND the coverage history, including under link loss and
+churn — making the batch axis a pure throughput lever. Plus the ensemble
+statistics against a numpy oracle, replica-batch chunking/padding, mesh
+sharding of the replica axis, and the sweep runner's record contract.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import p2p_gossip_tpu as pg
+from p2p_gossip_tpu.batch.campaign import (
+    ReplicaSet,
+    flood_replicas,
+    gossip_replicas,
+    run_coverage_campaign,
+    run_gossip_campaign,
+)
+from p2p_gossip_tpu.batch.stats import (
+    ensemble_summary,
+    format_campaign_report,
+    mean_ci,
+    percentile_summary,
+    ttc_matrix,
+)
+from p2p_gossip_tpu.engine.sync import run_flood_coverage, run_sync_sim
+from p2p_gossip_tpu.models.linkloss import LinkLossModel
+
+
+def _flood_solo(g, seed, shares, horizon, churn=None, loss=None, chunk=128):
+    origins = (
+        np.random.default_rng(seed).integers(0, g.n, shares).astype(np.int32)
+    )
+    return run_flood_coverage(
+        g, origins, horizon, churn=churn, loss=loss, chunk_size=chunk
+    )
+
+
+def test_coverage_campaign_bitwise_parity_plain():
+    """R=8, N=256: every replica equals the solo engine bitwise
+    (acceptance anchor)."""
+    g = pg.erdos_renyi(256, 0.05, seed=0)
+    horizon = 64
+    reps = flood_replicas(g, 3, list(range(8)), horizon)
+    res = run_coverage_campaign(g, reps, horizon, chunk_size=128)
+    assert res.coverage.shape == (8, horizon, 3)
+    for r in range(8):
+        stats, cov = _flood_solo(g, r, 3, horizon)
+        np.testing.assert_array_equal(cov, res.coverage[r])
+        np.testing.assert_array_equal(stats.received, res.received[r])
+        np.testing.assert_array_equal(stats.sent, res.sent[r])
+        np.testing.assert_array_equal(stats.generated, res.generated[r])
+        # The replica's NodeStats satisfies the reference conservation laws.
+        res.replica_stats(r).check_conservation()
+
+
+def test_coverage_campaign_bitwise_parity_loss_and_churn():
+    """The acceptance criterion's hard mode: identical counters and
+    coverage under --lossProb/--churnProb equivalents."""
+    g = pg.erdos_renyi(256, 0.05, seed=1)
+    horizon = 64
+    loss = LinkLossModel(0.2, seed=104729)
+    reps = flood_replicas(
+        g, 3, list(range(8)), horizon, churn_prob=0.5, mean_down_ticks=8
+    )
+    res = run_coverage_campaign(g, reps, horizon, loss=loss, chunk_size=128)
+    for r in range(8):
+        stats, cov = _flood_solo(
+            g, r, 3, horizon, churn=reps.replica_churn(r), loss=loss
+        )
+        np.testing.assert_array_equal(cov, res.coverage[r])
+        np.testing.assert_array_equal(stats.received, res.received[r])
+        np.testing.assert_array_equal(stats.sent, res.sent[r])
+        np.testing.assert_array_equal(stats.generated, res.generated[r])
+
+
+def test_coverage_campaign_pad_width_invariance():
+    """Results must not depend on the share pad (the lane-pad lever):
+    chunk 128 vs the solo MIN_CHUNK default give identical tensors."""
+    g = pg.erdos_renyi(128, 0.08, seed=2)
+    reps = flood_replicas(g, 2, [0, 1, 2], 32)
+    a = run_coverage_campaign(g, reps, 32, chunk_size=128)
+    b = run_coverage_campaign(g, reps, 32, chunk_size=None)
+    np.testing.assert_array_equal(a.coverage, b.coverage)
+    np.testing.assert_array_equal(a.received, b.received)
+    np.testing.assert_array_equal(a.sent, b.sent)
+
+
+def test_coverage_campaign_batch_chunking_and_sentinel_padding():
+    """batch_size=3 over R=8 (3+3+2, last batch sentinel-padded) must
+    equal the single-batch run bitwise."""
+    g = pg.erdos_renyi(128, 0.08, seed=3)
+    reps = flood_replicas(g, 2, list(range(8)), 32)
+    whole = run_coverage_campaign(g, reps, 32, chunk_size=64)
+    split = run_coverage_campaign(g, reps, 32, chunk_size=64, batch_size=3)
+    np.testing.assert_array_equal(whole.coverage, split.coverage)
+    np.testing.assert_array_equal(whole.received, split.received)
+    np.testing.assert_array_equal(whole.sent, split.sent)
+
+
+def test_coverage_campaign_mesh_sharded_replica_axis():
+    """Replica axis sharded over the (shares, nodes) mesh: identical
+    results to the unsharded run (conftest provides 8 virtual devices)."""
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+
+    g = pg.erdos_renyi(128, 0.08, seed=4)
+    reps = flood_replicas(g, 2, list(range(8)), 32, churn_prob=0.3)
+    plain = run_coverage_campaign(g, reps, 32, chunk_size=64)
+    mesh = make_mesh(2, 4)
+    sharded = run_coverage_campaign(g, reps, 32, chunk_size=64, mesh=mesh)
+    np.testing.assert_array_equal(plain.coverage, sharded.coverage)
+    np.testing.assert_array_equal(plain.received, sharded.received)
+    # R=5 does not divide the 8 mesh devices: batch must round up and pad.
+    reps5 = flood_replicas(g, 2, list(range(5)), 32)
+    shard5 = run_coverage_campaign(g, reps5, 32, chunk_size=64, mesh=mesh)
+    assert shard5.batch_size == 8
+    plain5 = run_coverage_campaign(g, reps5, 32, chunk_size=64)
+    np.testing.assert_array_equal(plain5.coverage, shard5.coverage)
+
+
+def test_gossip_campaign_bitwise_parity_multichunk():
+    """Full gossip schedules (uniform renewal, per-replica lengths) with
+    a chunk size that forces multiple share chunks: counters equal solo
+    run_sync_sim per replica."""
+    g = pg.erdos_renyi(64, 0.15, seed=5)
+    horizon = 40
+    reps = gossip_replicas(
+        g, sim_time=4.0, tick_dt=0.1, seeds=[3, 4, 5, 6], horizon=horizon,
+        churn_prob=0.3, mean_down_ticks=8,
+    )
+    assert reps.shares_per_replica > 32  # multi-chunk at chunk_size=32
+    res = run_gossip_campaign(g, reps, horizon, chunk_size=32)
+    assert res.coverage is None
+    for r in range(4):
+        stats = run_sync_sim(
+            g, reps.replica_schedule(r, horizon), horizon, chunk_size=32,
+            churn=reps.replica_churn(r),
+        )
+        np.testing.assert_array_equal(stats.received, res.received[r])
+        np.testing.assert_array_equal(stats.sent, res.sent[r])
+        np.testing.assert_array_equal(stats.generated, res.generated[r])
+
+
+def test_replica_set_validation():
+    with pytest.raises(ValueError, match="matching"):
+        ReplicaSet(
+            n=4,
+            origins=np.zeros((2, 3), dtype=np.int32),
+            gen_ticks=np.zeros((2, 4), dtype=np.int32),
+            seeds=np.arange(2),
+        )
+    with pytest.raises(ValueError, match="one seed per replica"):
+        ReplicaSet(
+            n=4,
+            origins=np.zeros((2, 3), dtype=np.int32),
+            gen_ticks=np.zeros((2, 3), dtype=np.int32),
+            seeds=np.arange(3),
+        )
+
+
+# ---------------------------------------------------------------- stats ----
+
+
+def test_percentile_summary_against_numpy_oracle():
+    rng = np.random.default_rng(0)
+    samples = rng.integers(0, 100, 257).astype(np.float64)
+    s = percentile_summary(samples)
+    assert s["p50"] == np.percentile(samples, 50)
+    assert s["p95"] == np.percentile(samples, 95)
+    assert s["p99"] == np.percentile(samples, 99)
+    assert s["mean"] == samples.mean()
+    assert s["min"] == samples.min() and s["max"] == samples.max()
+    assert s["samples"] == 257
+    assert percentile_summary(np.array([])) is None
+
+
+def test_mean_ci_against_numpy_oracle():
+    rng = np.random.default_rng(1)
+    x = rng.normal(50, 10, 64)
+    c = mean_ci(x)
+    assert c["mean"] == pytest.approx(x.mean())
+    assert c["std"] == pytest.approx(x.std(ddof=1))
+    half = 1.959963984540054 * x.std(ddof=1) / np.sqrt(64)
+    assert c["ci95"][0] == pytest.approx(x.mean() - half)
+    assert c["ci95"][1] == pytest.approx(x.mean() + half)
+    # Degenerate ensembles: single replica has no spread estimate; empty
+    # has no mean. Strict-JSON safe (None, never NaN).
+    one = mean_ci(np.array([7.0]))
+    assert one == {"mean": 7.0, "std": None, "ci95": None, "n": 1}
+    assert mean_ci(np.array([]))["mean"] is None
+
+
+def test_ttc_matrix_matches_propagation_latency_per_replica():
+    g = pg.erdos_renyi(128, 0.08, seed=6)
+    reps = flood_replicas(g, 3, [0, 1], 32)
+    res = run_coverage_campaign(g, reps, 32, chunk_size=128)
+    from p2p_gossip_tpu.utils.analysis import propagation_latency
+
+    ttc = ttc_matrix(res.coverage, g.n, 0.99)
+    for r in range(2):
+        rep = propagation_latency(res.coverage[r], g.n, fractions=(0.99,))
+        np.testing.assert_array_equal(ttc[r], rep.latency[0.99])
+
+
+def test_ensemble_summary_is_strict_json_and_single_replica_safe():
+    g = pg.erdos_renyi(64, 0.15, seed=7)
+    reps = flood_replicas(g, 2, [5], 32)  # R=1: CIs must be None, not NaN
+    res = run_coverage_campaign(g, reps, 32, chunk_size=64)
+    summary = ensemble_summary(res, 0.99)
+    text = json.dumps(summary)  # raises on numpy scalars
+    assert "NaN" not in text and "Infinity" not in text
+    assert summary["counters"]["received"]["ci95"] is None
+    assert summary["ttc"]["reached"] == 1.0
+
+
+def test_coverage_per_slot_scan_matches_oracle():
+    """The campaign kernels' scan-form coverage reduction is bitwise the
+    unrolled oracle (ops/bitmask.py)."""
+    import jax.numpy as jnp
+
+    from p2p_gossip_tpu.ops import bitmask
+
+    rows = np.random.default_rng(2).integers(
+        0, 2**32, (65, 5), dtype=np.uint32
+    )
+    a = bitmask.coverage_per_slot(jnp.asarray(rows), 150)
+    b = bitmask.coverage_per_slot_scan(jnp.asarray(rows), 150)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------- sweep ----
+
+
+def test_sweep_grid_expansion_and_validation():
+    from p2p_gossip_tpu.batch.sweep import expand_grid
+
+    cells = expand_grid(
+        {
+            "numNodes": 32,
+            "protocol": ["push", "pushk"],
+            "lossProb": [0.0, 0.1],
+            "fanout": [2, 3],
+            "replicas": 2,
+            "shares": 2,
+            "horizon": 16,
+        }
+    )
+    # push collapses the fanout axis; pushk keeps both values.
+    assert sum(c["protocol"] == "push" for c in cells) == 2
+    assert sum(c["protocol"] == "pushk" for c in cells) == 4
+    with pytest.raises(ValueError, match="unknown sweep keys"):
+        expand_grid({"numNodez": 32})
+    with pytest.raises(ValueError, match="cannot be a grid axis"):
+        expand_grid({"numNodes": [32, 64]})
+
+
+def test_sweep_records_contract():
+    """One strict-JSON record per cell with ttc percentiles and CIs; the
+    partnered protocol rides the sequential path with honest labels, and
+    the report renders."""
+    from p2p_gossip_tpu.batch.sweep import run_sweep
+
+    spec = {
+        "numNodes": 48,
+        "p": 0.15,
+        "protocol": ["push", "pushk"],
+        "fanout": [2],
+        "replicas": 3,
+        "shares": 2,
+        "horizon": 24,
+    }
+    emitted = []
+    records = run_sweep(spec, emit=emitted.append)
+    assert len(records) == 2 and emitted == records
+    for rec in records:
+        line = json.dumps(rec)
+        assert "NaN" not in line and "Infinity" not in line
+        assert rec["platform"] == "cpu"
+        s = rec["summary"]
+        assert {"ttc", "counters", "redundancy"} <= set(s)
+        assert s["counters"]["received"]["ci95"] is not None
+    by_proto = {r["cell"]["protocol"]: r for r in records}
+    assert by_proto["push"]["engine"] == "vmap"
+    assert by_proto["pushk"]["engine"] == "sequential"
+    report = format_campaign_report(records)
+    assert "push" in report and "pushk" in report and "ttc p50" in report

@@ -527,3 +527,55 @@ def test_link_queueing_flag_and_guards():
     assert r.returncode == 2 and "twice" in r.stderr
     r = _run_cli(*common, "--backend", "event", "--bandwidthMbps", "0")
     assert r.returncode == 2 and "--bandwidthMbps > 0" in r.stderr
+
+
+def test_replicas_campaign_cli_json():
+    """--replicas R --floodCoverage S: ensemble report + one JSON line
+    with ttc percentiles and counter CIs (batch campaign engine)."""
+    import json
+
+    r = _run_cli(
+        "--numNodes", "96", "--connectionProb", "0.08", "--simTime", "2",
+        "--Latency", "5", "--backend", "tpu", "--floodCoverage", "2",
+        "--replicas", "4", "--seed", "2", "--json",
+    )
+    assert r.returncode == 0, r.stderr
+    assert "=== Campaign: 4 replicas x 2 flood shares" in r.stdout
+    row = json.loads(r.stdout.strip().splitlines()[-1])
+    assert row["config"]["replicas"] == 4
+    assert row["summary"]["counters"]["received"]["ci95"] is not None
+    assert row["summary"]["ttc"]["fraction"] == 0.99
+
+
+def test_replicas_campaign_cli_validation():
+    r = _run_cli("--numNodes", "16", "--replicas", "0")
+    assert r.returncode == 2 and "--replicas" in r.stderr
+    r = _run_cli(
+        "--numNodes", "16", "--replicas", "2", "--backend", "event",
+    )
+    assert r.returncode == 2 and "--backend tpu" in r.stderr
+    r = _run_cli(
+        "--numNodes", "16", "--replicas", "2", "--protocol", "pushk",
+    )
+    assert r.returncode == 2 and "--sweep" in r.stderr
+
+
+def test_sweep_cli(tmp_path):
+    """--sweep spec.json: one JSON line per grid cell on stdout, campaign
+    report on stderr."""
+    import json
+
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps({
+        "numNodes": 48, "p": 0.15, "protocol": "push",
+        "lossProb": [0.0, 0.2], "replicas": 2, "shares": 2, "horizon": 16,
+    }))
+    r = _run_cli("--sweep", str(spec))
+    assert r.returncode == 0, r.stderr
+    rows = [json.loads(line) for line in r.stdout.strip().splitlines()]
+    assert len(rows) == 2
+    assert {row["cell"]["lossProb"] for row in rows} == {0.0, 0.2}
+    assert all(row["engine"] == "vmap" for row in rows)
+    assert "=== Campaign Report ===" in r.stderr
+    missing = _run_cli("--sweep", str(tmp_path / "nope.json"))
+    assert missing.returncode == 2

@@ -80,6 +80,30 @@ def test_minimal_graph_flood_parity():
         assert native.run_native_sim(g, sched, 10).equal_counts(ev)
 
 
+def test_every_module_importable_under_cpu():
+    """Every module under p2p_gossip_tpu/ must import cleanly under
+    JAX_PLATFORMS=cpu (conftest pins it). The seed shipped with `from
+    jax import shard_map` in the sharded engines — an import error the
+    suite only hit as 5 collection errors; this test names the broken
+    module directly and guards every future one."""
+    import importlib
+    import pkgutil
+
+    import p2p_gossip_tpu
+
+    failures = []
+    for mod in pkgutil.walk_packages(
+        p2p_gossip_tpu.__path__, prefix="p2p_gossip_tpu."
+    ):
+        if mod.name.endswith("__main__"):
+            continue  # importing __main__ runs the CLI
+        try:
+            importlib.import_module(mod.name)
+        except Exception as e:  # noqa: BLE001 - report every breakage
+            failures.append(f"{mod.name}: {type(e).__name__}: {e}")
+    assert not failures, "unimportable modules:\n" + "\n".join(failures)
+
+
 def test_single_node_degenerate_graph():
     """The reference crashes on numNodes=1 (no valid forced edge); we
     produce the degenerate one-node graph and every engine handles it:

@@ -687,3 +687,28 @@ def test_battery_report_prefers_corrected_standalone_summary(tmp_path):
     r2 = _run_script("battery_report.py", str(art))
     assert "1111" in r2.stdout and "2222" not in r2.stdout
     assert "battery-time parse" not in r2.stdout
+
+
+def test_sweep_script_contract(tmp_path):
+    """scripts/sweep.py: one JSON line per cell on stdout, report on
+    stderr, --out file mirror — the campaign artifact contract, rendered
+    with no TPU attached."""
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps({
+        "numNodes": 48, "p": 0.15, "protocol": "push",
+        "lossProb": [0.0, 0.2], "replicas": 2, "shares": 2, "horizon": 16,
+    }))
+    out = tmp_path / "campaign.jsonl"
+    r = _run_script(
+        "sweep.py", "--sweep", str(spec), "--out", str(out),
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows = [json.loads(line) for line in r.stdout.strip().splitlines()]
+    assert len(rows) == 2
+    for row in rows:
+        assert row["platform"] == "cpu"  # honest label, no TPU here
+        ttc = row["summary"]["ttc"]
+        assert ttc["ticks"] is None or "p99" in ttc["ticks"]
+    assert "=== Campaign Report ===" in r.stderr
+    mirrored = [json.loads(line) for line in out.read_text().splitlines()]
+    assert mirrored == rows
