@@ -290,6 +290,50 @@ def main() -> None:
         f"{seq_warm / camp_wall:.1f}x ({camp_label})"
     )
 
+    # Protocol campaign (batch/campaign.py run_protocol_campaign): the
+    # random-partner trio batches too. Baseline is the sweep's former
+    # sequential-per-seed engine — one solo run_pushpull_sim per seed
+    # sharing a warm compile (its best case) — timed in full; the
+    # campaign is reported both cold (incl. its one compile) and warm
+    # (the steady-state a multi-cell sweep pays). Same honest platform
+    # label as the flood campaign.
+    from p2p_gossip_tpu.batch.campaign import run_protocol_campaign
+    from p2p_gossip_tpu.models.protocols import run_pushpull_sim
+
+    t0 = time.perf_counter()
+    pcamp = run_protocol_campaign(
+        camp_graph, camp_reps, camp_h, protocol="pushpull"
+    )
+    pcamp_wall = time.perf_counter() - t0  # includes the one compile
+    t0 = time.perf_counter()
+    run_protocol_campaign(camp_graph, camp_reps, camp_h, protocol="pushpull")
+    pcamp_warm = time.perf_counter() - t0
+    pcamp_processed = int((pcamp.generated + pcamp.received).sum())
+
+    def _solo_pp(s):
+        origins = np.random.default_rng(s).integers(
+            0, camp_graph.n, camp_s
+        ).astype(np.int32)
+        sched = pg.Schedule(
+            camp_graph.n, origins, np.zeros(camp_s, dtype=np.int32)
+        )
+        run_pushpull_sim(
+            camp_graph, sched, camp_h, seed=int(s), record_coverage=True
+        )
+
+    _solo_pp(0)  # compile outside the timed warm loop
+    t0 = time.perf_counter()
+    for s in range(camp_r):
+        _solo_pp(s)
+    pp_seq_warm = time.perf_counter() - t0
+    log(
+        f"protocol campaign: R={camp_r} x N={camp_n} pushpull in "
+        f"{pcamp_wall:.2f}s cold / {pcamp_warm:.2f}s warm; sequential "
+        f"warm loop {pp_seq_warm:.2f}s -> "
+        f"{pp_seq_warm / pcamp_wall:.1f}x cold / "
+        f"{pp_seq_warm / pcamp_warm:.1f}x warm ({camp_label})"
+    )
+
     row = {
         "metric": (
             f"node-updates/sec ({n // 1000}K-node p={p:g} gossip "
@@ -331,6 +375,19 @@ def main() -> None:
         "warm_loop_wall_s": round(seq_warm, 4),
         "speedup_vs_sequential": round(seq_fresh_est / camp_wall, 2),
         "speedup_vs_warm_loop": round(seq_warm / camp_wall, 2),
+    }
+    row["protocol_campaign"] = {
+        "metric": (
+            f"pushpull campaign node-updates/s (R={camp_r} x "
+            f"{camp_n}-node, one jit, {camp_label})"
+        ),
+        "value": round(pcamp_processed / max(pcamp_warm, 1e-9), 1),
+        "replicas": camp_r,
+        "wall_s": round(pcamp_wall, 4),
+        "warm_wall_s": round(pcamp_warm, 4),
+        "sequential_warm_loop_s": round(pp_seq_warm, 4),
+        "speedup_incl_compile": round(pp_seq_warm / pcamp_wall, 2),
+        "speedup_warm_vs_warm_loop": round(pp_seq_warm / pcamp_warm, 2),
     }
     if profile_dir:
         # Tracing adds per-op overhead: mark the row so artifact pickers

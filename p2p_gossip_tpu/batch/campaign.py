@@ -17,13 +17,28 @@ shared-loop form measured ~4x cheaper to compile and run.)
 What varies per replica (the seed ensemble): the generation schedule
 (origins + gen ticks) and the churn downtime intervals, both sampled
 host-side from the replica's seed with the same stream offsets the CLI
-uses (so ``--seed s`` solo runs reproduce replica ``s`` exactly). What is
-shared across a batch (the cell config): the graph, the delay model, and
-the link-loss model — loss is a static (threshold, seed) pair baked into
-the compiled program; its per-message coins still differ across replicas
-because the hash keys on arrival ticks, which the per-replica schedules
-shift. Per-replica loss seeds would need a traced seed through the gather
-(ROADMAP open item).
+uses (so ``--seed s`` solo runs reproduce replica ``s`` exactly), and —
+optionally — the link-loss seed: ``loss_seeds`` threads one uint32 seed
+per replica as a traced operand through the gather's erasure coin
+(ops/ell.py), so each replica draws an independent loss stream that a
+solo run with the same loss seed reproduces bitwise. Without
+``loss_seeds``, loss stays the shared static (threshold, seed) pair
+baked into the compiled program (the cell-config reading). The graph and
+the delay model are always shared.
+
+The random-partner protocols (push-pull / pull / fanout push) batch the
+same way via ``run_protocol_campaign``: one jitted ``vmap`` of the solo
+round scan in ``models/protocols.py`` over (schedule, partner-pick seed,
+loss seed, churn) — the counter-based pick hash keys on (node, round,
+seed), so per-replica partner streams decorrelate while each replica
+matches its solo run's choices bitwise.
+
+Long campaigns checkpoint at replica-batch boundaries: accumulated
+per-replica counters (and coverage rows) are snapshotted atomically
+every ``checkpoint_every`` batches, fingerprinted over the replica seed
+list and the full cell config, so an interrupted campaign resumes after
+its last completed batch instead of restarting from zero
+(utils/checkpoint.py).
 
 Replicas are chunked to a static ``batch_size`` so XLA compiles one
 program regardless of R; padding replicas get the never-fires gen-tick
@@ -273,7 +288,8 @@ def _shard_batch(mesh, arrays):
 
 
 def _batched_tick(dg, block, t, seen, hist, received, sent,
-                  origins_b, gen_ticks_b, churn_b, slots, loss):
+                  origins_b, gen_ticks_b, churn_b, slots, loss,
+                  loss_seeds_b=None):
     """One global tick over the whole (B, ...) replica batch: ``vmap`` of
     the solo engine's ``_tick_body`` (which carries the shared counter
     semantics) over the replica axis, at a COMMON tick counter ``t``.
@@ -285,22 +301,34 @@ def _batched_tick(dg, block, t, seen, hist, received, sent,
     state; a replica past its own quiescence simply has an all-zero
     frontier, so every update it computes is the identity — bitwise, not
     approximately — and the batch runs until the slowest replica settles.
+
+    ``loss_seeds_b`` (optional (B,) uint32) vmaps a per-replica loss seed
+    into the gather's erasure coin; ``loss`` is then (threshold, None).
     """
 
-    def tick_one(seen, hist, received, sent, origins, gen_ticks, churn):
+    def tick_one(seen, hist, received, sent, origins, gen_ticks, churn,
+                 lseed=None):
         _, seen, hist, received, sent = _tick_body(
             dg, block, (t, seen, hist, received, sent), origins, slots,
-            gen_ticks, churn, loss,
+            gen_ticks, churn, loss, 0, lseed,
         )
         return seen, hist, received, sent
 
-    if churn_b is None:
-        return jax.vmap(
-            lambda se, h, r, sn, o, g: tick_one(se, h, r, sn, o, g, None)
-        )(seen, hist, received, sent, origins_b, gen_ticks_b)
-    return jax.vmap(tick_one)(
-        seen, hist, received, sent, origins_b, gen_ticks_b, churn_b
-    )
+    args = [seen, hist, received, sent, origins_b, gen_ticks_b]
+    if churn_b is None and loss_seeds_b is None:
+        fn = lambda se, h, r, sn, o, g: tick_one(se, h, r, sn, o, g, None)
+    elif loss_seeds_b is None:
+        fn = tick_one
+        args.append(churn_b)
+    elif churn_b is None:
+        fn = lambda se, h, r, sn, o, g, ls: tick_one(
+            se, h, r, sn, o, g, None, ls
+        )
+        args.append(loss_seeds_b)
+    else:
+        fn = tick_one
+        args += [churn_b, loss_seeds_b]
+    return jax.vmap(fn)(*args)
 
 
 @functools.partial(
@@ -312,6 +340,7 @@ def _run_coverage_batch(
     origins_b: jnp.ndarray,    # (B, S) int32
     gen_ticks_b: jnp.ndarray,  # (B, S) int32
     churn_b=None,              # optional ((B, N, K), (B, N, K))
+    loss_seeds_b=None,         # optional (B,) uint32 per-replica loss seeds
     *,
     chunk_size: int,
     horizon: int,
@@ -356,7 +385,7 @@ def _run_coverage_batch(
         t, seen, hist, received, sent, cov_run, cov_hist = full_state
         seen, hist, received, sent = _batched_tick(
             dg, block, t, seen, hist, received, sent,
-            origins_b, gen_ticks_b, churn_b, slots, loss,
+            origins_b, gen_ticks_b, churn_b, slots, loss, loss_seeds_b,
         )
         cov_run = cov_run + cov_delta_of(hist[:, jnp.mod(t, dg.ring_size)])
         cov_hist = jax.lax.dynamic_update_slice(
@@ -385,6 +414,7 @@ def _run_while_batch(
     t_start: jnp.ndarray,   # scalar int32 — min live gen tick of the batch
     last_gen: jnp.ndarray,  # scalar int32 — max live gen tick of the batch
     churn_b=None,
+    loss_seeds_b=None,      # optional (B,) uint32 per-replica loss seeds
     *,
     chunk_size: int,
     horizon: int,
@@ -415,7 +445,7 @@ def _run_while_batch(
         t, seen, hist, received, sent = state
         seen, hist, received, sent = _batched_tick(
             dg, block, t, seen, hist, received, sent,
-            origins_b, gen_ticks_b, churn_b, slots, loss,
+            origins_b, gen_ticks_b, churn_b, slots, loss, loss_seeds_b,
         )
         return (t + 1, seen, hist, received, sent)
 
@@ -423,17 +453,33 @@ def _run_while_batch(
     return seen, received, sent
 
 
-def _iter_batches(replicas: ReplicaSet, batch_size: int, horizon: int):
+def _iter_batches(
+    replicas: ReplicaSet, batch_size: int, horizon: int, loss_seeds=None
+):
     """Slice the replica axis into static-size batches. The last batch is
     padded with sentinel replicas (gen_ticks == horizon everywhere): they
     generate nothing, converge immediately under the batched while_loop,
-    and their rows are dropped on the host side."""
+    and their rows are dropped on the host side. Yields
+    ``(lo, live, origins, gen_ticks, churn, seeds, lseeds)`` — ``seeds``
+    the replicas' own seeds masked to uint32 (the partner-pick streams of
+    the protocol campaigns), ``lseeds`` the per-replica loss seeds (None
+    when ``loss_seeds`` is None); both zero-padded like the schedules."""
     r_total = replicas.num_replicas
+    seeds_u32 = (replicas.seeds & 0xFFFFFFFF).astype(np.uint32)
+    lseeds_u32 = (
+        None
+        if loss_seeds is None
+        else (np.asarray(loss_seeds, dtype=np.int64) & 0xFFFFFFFF).astype(
+            np.uint32
+        )
+    )
     for lo in range(0, r_total, batch_size):
         hi = min(lo + batch_size, r_total)
         live = hi - lo
         origins = replicas.origins[lo:hi]
         gen_ticks = replicas.gen_ticks[lo:hi]
+        seeds = seeds_u32[lo:hi]
+        lseeds = None if lseeds_u32 is None else lseeds_u32[lo:hi]
         churn = (
             None
             if replicas.churn is None
@@ -448,13 +494,74 @@ def _iter_batches(replicas: ReplicaSet, batch_size: int, horizon: int):
                 [gen_ticks,
                  np.full((pad, gen_ticks.shape[1]), horizon, dtype=np.int32)]
             )
+            seeds = np.concatenate([seeds, np.zeros(pad, dtype=np.uint32)])
+            if lseeds is not None:
+                lseeds = np.concatenate(
+                    [lseeds, np.zeros(pad, dtype=np.uint32)]
+                )
             if churn is not None:
                 zpad = np.zeros((pad,) + churn[0].shape[1:], dtype=np.int32)
                 churn = (
                     np.concatenate([churn[0], zpad]),
                     np.concatenate([churn[1], zpad.copy()]),
                 )
-        yield lo, live, origins, gen_ticks, churn
+        yield lo, live, origins, gen_ticks, churn, seeds, lseeds
+
+
+def _resolve_loss(loss, loss_seeds, r_total: int):
+    """The one conversion point between the loss model and the batched
+    kernels: returns ``(static_cfg, lseed_array)``.
+
+    - no loss:            ``(None, None)`` — coins off.
+    - shared (cell) loss: ``((threshold, seed), None)`` — the static pair,
+      bitwise the pre-existing campaign behavior.
+    - per-replica loss:   ``((threshold, None), (R,) int64 seeds)`` — the
+      threshold stays compile-time config, the seed rides the batch axis
+      so each replica draws an independent erasure stream (a solo run
+      with ``LinkLossModel(prob, seed=loss_seeds[r])`` reproduces replica
+      r bitwise).
+    """
+    if loss_seeds is not None:
+        if loss is None:
+            raise ValueError("loss_seeds requires a loss model")
+        arr = np.asarray(loss_seeds, dtype=np.int64).reshape(-1)
+        if arr.shape[0] != r_total:
+            raise ValueError(
+                f"loss_seeds must have one seed per replica ({r_total}), "
+                f"got {arr.shape[0]}"
+            )
+        return (loss.threshold, None), arr
+    return (loss.static_cfg if loss is not None else None), None
+
+
+def _campaign_checkpointer(
+    checkpoint_path, checkpoint_every, kind: str, graph, replicas: ReplicaSet,
+    horizon: int, chunk: int, dg: DeviceGraph, batch_size: int,
+    loss_cfg, loss_seed_arr, arrays: dict, extra: tuple = (),
+):
+    """Batch-boundary checkpointing shared by every campaign runner: the
+    accumulated per-replica arrays (counters, and coverage rows — a
+    completed batch's coverage is whole, unlike the share-chunk engines
+    where skipped chunks would lose history) keyed by a fingerprint over
+    the replica seed list and everything else that determines the run —
+    including ``batch_size``, which determines the batch partitioning the
+    resume index counts in."""
+    if checkpoint_path is None:
+        return None
+    from p2p_gossip_tpu.engine.sync import _canonical_delays
+    from p2p_gossip_tpu.utils.checkpoint import ChunkCheckpointer, fingerprint
+
+    fp = fingerprint(
+        "campaign", kind, graph.n, graph.edges(), replicas.origins,
+        replicas.gen_ticks, replicas.seeds, horizon, chunk,
+        _canonical_delays(dg), dg.uniform_delay, dg.ring_size, batch_size,
+        replicas.churn[0] if replicas.churn is not None else None,
+        replicas.churn[1] if replicas.churn is not None else None,
+        *(["loss", loss_cfg[0], loss_cfg[1]] if loss_cfg else []),
+        *(["lseeds", loss_seed_arr] if loss_seed_arr is not None else []),
+        *extra,
+    )
+    return ChunkCheckpointer(checkpoint_path, fp, arrays, checkpoint_every)
 
 
 def _resolve_batch(replicas: ReplicaSet, batch_size: int | None, mesh) -> int:
@@ -494,11 +601,15 @@ def run_coverage_campaign(
     ell_delays: np.ndarray | None = None,
     constant_delay: int = 1,
     loss=None,
+    loss_seeds=None,
     batch_size: int | None = None,
     chunk_size: int | None = None,
     block: int | None = None,
     device_graph: DeviceGraph | None = None,
     mesh=None,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 1,
+    stop_after_batches: int | None = None,
 ) -> CampaignResult:
     """Coverage-recording campaign: every replica runs the flood/coverage
     experiment (``engine.sync.run_flood_coverage`` semantics — arbitrary
@@ -514,6 +625,11 @@ def run_coverage_campaign(
     CPU a packed pad near the actual share count — at S=4, R=32, N=1024
     the packed pad measured ~20x faster end-to-end (the replica axis
     supplies the parallelism the lane pad existed to buy).
+
+    ``loss_seeds`` (one per replica) switches the erasure coin to
+    per-replica streams; ``checkpoint_path``/``checkpoint_every`` enable
+    batch-boundary snapshots and resume (``stop_after_batches`` simulates
+    interruption) — see the module docstring.
     """
     s = replicas.shares_per_replica
     dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
@@ -524,7 +640,7 @@ def run_coverage_campaign(
         floor = chunk_size
     chunk = bitmask.num_words(max(s, floor)) * bitmask.WORD_BITS
     block = _resolve_block(dg, block)
-    loss_cfg = loss.static_cfg if loss is not None else None
+    loss_cfg, lseed_arr = _resolve_loss(loss, loss_seeds, replicas.num_replicas)
     batch_size = _resolve_batch(replicas, batch_size, mesh)
     r_total = replicas.num_replicas
     log.info(
@@ -536,23 +652,35 @@ def run_coverage_campaign(
     received = np.zeros((r_total, graph.n), dtype=np.int64)
     sent = np.zeros((r_total, graph.n), dtype=np.int64)
     coverage = np.zeros((r_total, horizon, s), dtype=np.int32)
+    checkpointer = _campaign_checkpointer(
+        checkpoint_path, checkpoint_every, "coverage", graph, replicas,
+        horizon, chunk, dg, batch_size, loss_cfg, lseed_arr,
+        {"received": received, "sent": sent, "coverage": coverage},
+    )
+    from p2p_gossip_tpu.utils.checkpoint import checkpointed_chunks
+
+    batches = list(_iter_batches(replicas, batch_size, horizon, lseed_arr))
     t0 = time.perf_counter()
-    for lo, live, origins, gen_ticks, churn in _iter_batches(
-        replicas, batch_size, horizon
+    for _bi, batch in checkpointed_chunks(
+        batches, checkpointer, stop_after_batches
     ):
+        lo, live, origins, gen_ticks, churn, _seeds, lseeds = batch
         pad_o = np.zeros((batch_size, chunk), dtype=np.int32)
         pad_g = np.full((batch_size, chunk), horizon, dtype=np.int32)
         pad_o[:, :s] = origins
         pad_g[:, :s] = gen_ticks
-        pad_o, pad_g, *churn_parts = _shard_batch(
+        pad_o, pad_g, lseeds, *churn_parts = _shard_batch(
             mesh,
-            (pad_o, pad_g) + (churn if churn is not None else (None, None)),
+            (pad_o, pad_g, lseeds)
+            + (churn if churn is not None else (None, None)),
         )
         churn_dev = (
             None if churn_parts[0] is None else tuple(churn_parts)
         )
+        lseeds_dev = None if lseeds is None else jnp.asarray(lseeds)
         _, r, snt, cov = _run_coverage_batch(
             dg, jnp.asarray(pad_o), jnp.asarray(pad_g), churn_dev,
+            lseeds_dev,
             chunk_size=chunk, horizon=horizon, block=block, loss=loss_cfg,
             coverage_slots=s,
         )
@@ -582,23 +710,30 @@ def run_gossip_campaign(
     ell_delays: np.ndarray | None = None,
     constant_delay: int = 1,
     loss=None,
+    loss_seeds=None,
     batch_size: int | None = None,
     chunk_size: int = 4096,
     block: int | None = None,
     device_graph: DeviceGraph | None = None,
     mesh=None,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 1,
+    stop_after_batches: int | None = None,
 ) -> CampaignResult:
     """Counter-only campaign of the full gossip workload: R replicas of
     the reference simulation (per-replica generation schedules, arbitrary
     share counts) chunked over the share axis like the solo engine —
     counters are additive across chunks per replica. Per-replica counters
-    are bitwise-identical to solo ``run_sync_sim`` with the same seed."""
+    are bitwise-identical to solo ``run_sync_sim`` with the same seed.
+    ``loss_seeds``/checkpoint args as in `run_coverage_campaign`
+    (checkpoints land at replica-batch boundaries, each batch running all
+    its share chunks)."""
     s_max = replicas.shares_per_replica
     chunk = min(chunk_size, max(MIN_CHUNK_SHARES, s_max))
     chunk = bitmask.num_words(chunk) * bitmask.WORD_BITS
     dg = device_graph or DeviceGraph.build(graph, ell_delays, constant_delay)
     block = _resolve_block(dg, block)
-    loss_cfg = loss.static_cfg if loss is not None else None
+    loss_cfg, lseed_arr = _resolve_loss(loss, loss_seeds, replicas.num_replicas)
     batch_size = _resolve_batch(replicas, batch_size, mesh)
     r_total = replicas.num_replicas
     n_chunks = max(1, -(-s_max // chunk))
@@ -610,10 +745,19 @@ def run_gossip_campaign(
 
     received = np.zeros((r_total, graph.n), dtype=np.int64)
     sent = np.zeros((r_total, graph.n), dtype=np.int64)
+    checkpointer = _campaign_checkpointer(
+        checkpoint_path, checkpoint_every, "gossip", graph, replicas,
+        horizon, chunk, dg, batch_size, loss_cfg, lseed_arr,
+        {"received": received, "sent": sent},
+    )
+    from p2p_gossip_tpu.utils.checkpoint import checkpointed_chunks
+
+    batches = list(_iter_batches(replicas, batch_size, horizon, lseed_arr))
     t0 = time.perf_counter()
-    for lo, live, origins, gen_ticks, churn in _iter_batches(
-        replicas, batch_size, horizon
+    for _bi, batch in checkpointed_chunks(
+        batches, checkpointer, stop_after_batches
     ):
+        lo, live, origins, gen_ticks, churn, _seeds, lseeds = batch
         for ci in range(n_chunks):
             o_slice = origins[:, ci * chunk : (ci + 1) * chunk]
             g_slice = gen_ticks[:, ci * chunk : (ci + 1) * chunk]
@@ -629,16 +773,19 @@ def run_gossip_campaign(
             live_ticks = pad_g[pad_g < horizon]
             t_start = np.int32(live_ticks.min())
             last_gen = np.int32(live_ticks.max())
-            pad_o, pad_g, *churn_parts = _shard_batch(
+            pad_o, pad_g, lseeds_s, *churn_parts = _shard_batch(
                 mesh,
-                (pad_o, pad_g) + (churn if churn is not None else (None, None)),
+                (pad_o, pad_g, lseeds)
+                + (churn if churn is not None else (None, None)),
             )
             churn_dev = (
                 None if churn_parts[0] is None else tuple(churn_parts)
             )
+            lseeds_dev = None if lseeds_s is None else jnp.asarray(lseeds_s)
             _, r, snt = _run_while_batch(
                 dg, jnp.asarray(pad_o), jnp.asarray(pad_g),
                 jnp.asarray(t_start), jnp.asarray(last_gen), churn_dev,
+                lseeds_dev,
                 chunk_size=chunk, horizon=horizon, block=block, loss=loss_cfg,
             )
             received[lo : lo + live] += np.asarray(r, dtype=np.int64)[:live]
@@ -656,4 +803,179 @@ def run_gossip_campaign(
         wall_s=wall,
         batch_size=batch_size,
         coverage=None,
+    )
+
+
+def run_protocol_campaign(
+    graph: Graph,
+    replicas: ReplicaSet,
+    horizon: int,
+    protocol: str = "pushpull",
+    fanout: int = 2,
+    ell_delays: np.ndarray | None = None,
+    constant_delay: int = 1,
+    loss=None,
+    loss_seeds=None,
+    batch_size: int | None = None,
+    chunk_size: int | None = None,
+    device_graph: DeviceGraph | None = None,
+    record_coverage: bool = True,
+    mesh=None,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 1,
+    stop_after_batches: int | None = None,
+) -> CampaignResult:
+    """Replica campaign of the random-partner protocols — the Demers trio
+    minus eager push: ``pushpull``/``pull`` anti-entropy and ``pushk``
+    fanout push (``models/protocols.py``), R replicas in one jitted vmap
+    per share chunk.
+
+    Bitwise contract (the one the flood campaigns carry): row r of every
+    output equals a solo ``run_pushpull_sim``/``run_pushk_sim`` run with
+    ``seed=replicas.seeds[r]`` and replica r's schedule/churn under the
+    same loss model, including the coverage history. Partner picks are
+    the counter-based hash keyed on (node, round, seed) — a traced
+    per-replica operand — so replica streams decorrelate exactly as R
+    solo seeds do. ``loss_seeds`` gives each replica an independent
+    erasure stream (solo reference: ``LinkLossModel(prob,
+    seed=loss_seeds[r])``); without it the cell-shared static pair
+    applies to every replica, matching the sweep's sequential path
+    bitwise.
+
+    ``chunk_size=None`` picks the platform-aware pass width of
+    `run_coverage_campaign` (solo lane pad on TPU, packed pad on CPU —
+    the packed pad is most of the measured CPU speedup, since a solo run
+    pads S=4 shares to a 4096-wide bitmask); shares beyond one pass run
+    in chunks with exactly-additive counters. Checkpoints land at
+    replica-batch boundaries (each batch runs all its chunks), same
+    contract as `run_coverage_campaign`.
+    """
+    from p2p_gossip_tpu.models.protocols import (
+        _run_pushk_replicas,
+        _run_pushpull_replicas,
+        check_pull_credit_width,
+    )
+
+    if protocol not in ("pushpull", "pull", "pushk"):
+        raise ValueError(
+            f"protocol must be pushpull|pull|pushk, got {protocol!r}"
+        )
+    if protocol == "pushk" and fanout < 1:
+        raise ValueError(f"fanout must be >= 1, got {fanout}")
+    # Partner selection indexes the full-width ELL (models/protocols.py) —
+    # bucketed staging is not usable here, same rule as the solo driver.
+    dg = device_graph or DeviceGraph.build(
+        graph, ell_delays, constant_delay, bucketed=False
+    )
+    if dg.buckets is not None:
+        raise ValueError(
+            "protocol campaigns require a DeviceGraph built with "
+            "bucketed=False (partner selection reads the full ELL)"
+        )
+    s = replicas.shares_per_replica
+    if chunk_size is None:
+        on_tpu = any(d.platform == "tpu" for d in dg.ell_idx.devices())
+        if on_tpu:
+            chunk_size = MIN_CHUNK_SHARES  # full 128-lane tiles
+        else:
+            # Packed pad: word-round the actual share count (capped at
+            # 128-share passes). Narrow rows keep the push direction on
+            # the bit scatter-add (ops/segment.py) — at S=4 the pad is
+            # one uint32 word vs the solo engine's 128, which is most of
+            # the campaign's CPU advantage.
+            chunk_size = min(max(s, 1), min(MIN_CHUNK_SHARES, 128))
+    chunk = bitmask.num_words(max(chunk_size, 1)) * bitmask.WORD_BITS
+    if protocol == "pull":
+        check_pull_credit_width(graph, chunk)
+    loss_cfg, lseed_arr = _resolve_loss(loss, loss_seeds, replicas.num_replicas)
+    loss_thr = loss_cfg[0] if loss_cfg is not None else 0
+    if lseed_arr is None:
+        # The batched kernels take the loss seed as an operand either way;
+        # the shared (cell-config) seed simply rides it uniformly — the
+        # same coins as the solo static path (identical hash).
+        shared = loss_cfg[1] if loss_cfg is not None else 0
+        lseed_arr = np.full(replicas.num_replicas, shared, dtype=np.int64)
+    batch_size = _resolve_batch(replicas, batch_size, mesh)
+    r_total = replicas.num_replicas
+    n_chunks = max(1, -(-max(s, 1) // chunk))
+    log.info(
+        f"{protocol} campaign: {r_total} replicas x {graph.n} nodes x {s} "
+        f"shares in {n_chunks} chunk(s) of {chunk}, batch {batch_size}, "
+        f"horizon {horizon}"
+        + (f", mesh {mesh.devices.shape}" if mesh is not None else "")
+    )
+
+    received = np.zeros((r_total, graph.n), dtype=np.int64)
+    sent = np.zeros((r_total, graph.n), dtype=np.int64)
+    coverage = (
+        np.zeros((r_total, horizon, s), dtype=np.int32)
+        if record_coverage
+        else None
+    )
+    arrays = {"received": received, "sent": sent}
+    if record_coverage:
+        arrays["coverage"] = coverage
+    checkpointer = _campaign_checkpointer(
+        checkpoint_path, checkpoint_every, "protocol", graph, replicas,
+        horizon, chunk, dg, batch_size, loss_cfg, lseed_arr, arrays,
+        extra=(protocol, fanout if protocol == "pushk" else None),
+    )
+    from p2p_gossip_tpu.utils.checkpoint import checkpointed_chunks
+
+    batches = list(_iter_batches(replicas, batch_size, horizon, lseed_arr))
+    t0 = time.perf_counter()
+    for _bi, batch in checkpointed_chunks(
+        batches, checkpointer, stop_after_batches
+    ):
+        lo, live, origins, gen_ticks, churn, seeds, lseeds = batch
+        for ci in range(n_chunks):
+            o_slice = origins[:, ci * chunk : (ci + 1) * chunk]
+            g_slice = gen_ticks[:, ci * chunk : (ci + 1) * chunk]
+            live_s = o_slice.shape[1]
+            pad_o = np.zeros((batch_size, chunk), dtype=np.int32)
+            pad_g = np.full((batch_size, chunk), horizon, dtype=np.int32)
+            pad_o[:, :live_s] = o_slice
+            pad_g[:, :live_s] = g_slice
+            pad_o, pad_g, seeds_s, lseeds_s, *churn_parts = _shard_batch(
+                mesh,
+                (pad_o, pad_g, seeds, lseeds)
+                + (churn if churn is not None else (None, None)),
+            )
+            churn_dev = (
+                None if churn_parts[0] is None else tuple(churn_parts)
+            )
+            if protocol == "pushk":
+                _, r, (s_lo, s_hi), cov = _run_pushk_replicas(
+                    dg, jnp.asarray(pad_o), jnp.asarray(pad_g),
+                    jnp.asarray(seeds_s), jnp.asarray(lseeds_s), churn_dev,
+                    fanout=fanout, chunk_size=chunk, horizon=horizon,
+                    record_coverage=record_coverage, loss_threshold=loss_thr,
+                )
+            else:
+                _, r, (s_lo, s_hi), cov = _run_pushpull_replicas(
+                    dg, jnp.asarray(pad_o), jnp.asarray(pad_g),
+                    jnp.asarray(seeds_s), jnp.asarray(lseeds_s), churn_dev,
+                    chunk_size=chunk, horizon=horizon,
+                    record_coverage=record_coverage, loss_threshold=loss_thr,
+                    mode=protocol,
+                )
+            received[lo : lo + live] += np.asarray(r, dtype=np.int64)[:live]
+            sent[lo : lo + live] += bitmask.combine_u64(s_lo, s_hi)[:live]
+            if record_coverage:
+                coverage[lo : lo + live, :, ci * chunk : ci * chunk + live_s] = (
+                    np.asarray(cov)[:live, :, :live_s]
+                )
+    wall = time.perf_counter() - t0
+
+    return CampaignResult(
+        n=graph.n,
+        seeds=replicas.seeds,
+        generated=_campaign_generated(replicas, horizon),
+        received=received,
+        sent=sent,
+        degree=np.asarray(dg.degree, dtype=np.int64),
+        horizon=horizon,
+        wall_s=wall,
+        batch_size=batch_size,
+        coverage=coverage,
     )

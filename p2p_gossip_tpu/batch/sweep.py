@@ -21,10 +21,13 @@ Spec format (scalars are 1-element axes; ``example_spec()`` is runnable):
       "coverageFraction": 0.99, "baseSeed": 0
     }
 
-Engine selection is honest per cell: ``push`` rides the vmapped campaign
-engine (``engine: "vmap"``); the random-partner protocols run their solo
-engines once per seed (``engine: "sequential"``) until they grow a vmap
-axis (ROADMAP open item). Both produce identical record schemas.
+Every protocol rides the vmapped campaign engine (``engine: "vmap"``):
+``push`` through ``batch.campaign.run_coverage_campaign``, the
+random-partner protocols (pushpull / pull / pushk) through
+``run_protocol_campaign``. The per-seed sequential path
+(`_run_partnered_cell`) is kept as the cross-engine reference — the
+schema tests assert both engines emit identical records, and the
+``engine`` field reports honestly whichever one actually ran.
 """
 
 from __future__ import annotations
@@ -40,6 +43,7 @@ from p2p_gossip_tpu.batch.campaign import (
     CampaignResult,
     flood_replicas,
     run_coverage_campaign,
+    run_protocol_campaign,
 )
 from p2p_gossip_tpu.models import topology as topo
 from p2p_gossip_tpu.models.generation import Schedule
@@ -152,7 +156,9 @@ def _cell_loss(cell: dict) -> LinkLossModel | None:
 def _run_partnered_cell(cell, graph, seeds, loss) -> CampaignResult:
     """Sequential seed ensemble for the random-partner protocols: one solo
     engine run per seed, stacked into the same CampaignResult schema the
-    vmapped path produces."""
+    vmapped path produces. No longer the production path (protocol cells
+    ride ``run_protocol_campaign``) — kept as the cross-engine reference
+    the record-schema and bitwise-equality tests compare against."""
     from p2p_gossip_tpu.models.churn import random_churn
     from p2p_gossip_tpu.models.protocols import run_pushk_sim, run_pushpull_sim
 
@@ -208,23 +214,26 @@ def run_cell(
     graph = _build_graph(cell)
     loss = _cell_loss(cell)
     t0 = time.perf_counter()
+    if cell["protocol"] not in ("push", "pushpull", "pull", "pushk"):
+        raise ValueError(f"unknown protocol {cell['protocol']!r}")
+    replicas = flood_replicas(
+        graph, cell["shares"], seeds, cell["horizon"],
+        churn_prob=cell["churnProb"],
+        mean_down_ticks=cell["churnDowntimeTicks"],
+        max_outages=cell["churnOutages"],
+    )
     if cell["protocol"] == "push":
-        replicas = flood_replicas(
-            graph, cell["shares"], seeds, cell["horizon"],
-            churn_prob=cell["churnProb"],
-            mean_down_ticks=cell["churnDowntimeTicks"],
-            max_outages=cell["churnOutages"],
-        )
         result = run_coverage_campaign(
             graph, replicas, cell["horizon"], loss=loss,
             batch_size=batch_size, mesh=mesh,
         )
-        engine = "vmap"
-    elif cell["protocol"] in ("pushpull", "pull", "pushk"):
-        result = _run_partnered_cell(cell, graph, seeds, loss)
-        engine = "sequential"
     else:
-        raise ValueError(f"unknown protocol {cell['protocol']!r}")
+        result = run_protocol_campaign(
+            graph, replicas, cell["horizon"], protocol=cell["protocol"],
+            fanout=cell["fanout"], loss=loss, batch_size=batch_size,
+            mesh=mesh,
+        )
+    engine = "vmap"
     wall = time.perf_counter() - t0
 
     summary = bstats.ensemble_summary(result, cell["coverageFraction"])

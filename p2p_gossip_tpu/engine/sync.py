@@ -357,7 +357,7 @@ def apply_tick_updates(
 
 def _tick_body(
     dg: DeviceGraph, block: int, state, origins, slots, gen_ticks, churn=None,
-    loss=None, connect_tick: int = 0,
+    loss=None, connect_tick: int = 0, loss_seed=None,
 ):
     """One synchronous tick. state = (t, seen, hist, received, sent) ->
     state'. Coverage-recording callers derive the tick's coverage delta
@@ -370,7 +370,9 @@ def _tick_body(
 
     ``loss`` is an optional static (threshold, seed) pair — the per-link
     erasure model (models/linkloss.py), applied edge-wise inside the
-    gather before the OR-reduce.
+    gather before the OR-reduce. ``loss_seed`` (optional traced uint32
+    scalar) overrides the static seed — the campaign engine vmaps it so
+    every replica draws an independent erasure stream.
     """
     t, seen, hist, received, sent = state
     n, w = seen.shape
@@ -378,18 +380,19 @@ def _tick_body(
         arrivals = propagate_bucketed(
             hist, t, dg.buckets, n_out=n,
             ring_size=dg.ring_size, uniform_delay=dg.uniform_delay, block=block,
-            loss=loss,
+            loss=loss, loss_seed=loss_seed,
         )
     elif dg.uniform_delay is not None:
         arrivals = propagate_uniform(
             hist, t, dg.ell_idx, dg.ell_mask,
             ring_size=dg.ring_size, uniform_delay=dg.uniform_delay, block=block,
-            loss=loss,
+            loss=loss, loss_seed=loss_seed,
         )
     else:
         arrivals = propagate(
             hist, t, dg.ell_idx, dg.ell_delay, dg.ell_mask,
             ring_size=dg.ring_size, block=block, loss=loss,
+            loss_seed=loss_seed,
         )
     gen_active = gen_ticks == t
     if churn is not None:

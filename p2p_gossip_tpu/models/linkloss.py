@@ -82,14 +82,28 @@ def drop_mask_np(src, dst, tick, threshold: int, seed: int) -> np.ndarray:
     return h <= np.uint64(threshold - 1)
 
 
-def drop_mask_jnp(src, dst, tick, threshold: int, seed: int):
+def seed_u32_jnp(seed):
+    """``seed`` as a jnp uint32 scalar. Accepts a plain int (the static
+    path — masked host-side, since a value >= 2**31 would overflow
+    jnp.asarray's int32 default) or an already-traced array (the
+    per-replica campaign path, where each replica's erasure stream rides
+    a vmapped seed operand — uint32 cast wraps identically)."""
+    import jax.numpy as jnp
+
+    if isinstance(seed, (int, np.integer)):
+        return jnp.uint32(int(seed) & _MASK)
+    return jnp.asarray(seed).astype(jnp.uint32)
+
+
+def drop_mask_jnp(src, dst, tick, threshold: int, seed):
     """jnp evaluation — bit-identical to drop_mask_np (uint32 wraparound
     replaces the uint64+mask dance, which jax's default 32-bit mode can't
-    express)."""
+    express). ``seed`` may be a traced uint32 scalar (per-replica loss
+    streams); ``threshold`` stays static."""
     import jax.numpy as jnp
 
     h = (
-        jnp.uint32(seed & _MASK)
+        seed_u32_jnp(seed)
         ^ (jnp.asarray(src).astype(jnp.uint32) * jnp.uint32(_C_SRC))
         ^ (jnp.asarray(dst).astype(jnp.uint32) * jnp.uint32(_C_DST))
         ^ (jnp.asarray(tick).astype(jnp.uint32) * jnp.uint32(_C_TICK))

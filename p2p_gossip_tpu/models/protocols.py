@@ -40,7 +40,7 @@ from p2p_gossip_tpu.models.generation import Schedule
 from p2p_gossip_tpu.models.partnersel import pick_index_jnp
 from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
-from p2p_gossip_tpu.ops.segment import scatter_or
+from p2p_gossip_tpu.ops.segment import scatter_or_auto
 from p2p_gossip_tpu.utils.stats import NodeStats
 
 
@@ -56,11 +56,7 @@ def _select_partners(seed, t, ell_idx, ell_delay, degree, node_ids=None):
     return ell_idx[rows, k], ell_delay[rows, k]
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("chunk_size", "horizon", "record_coverage", "loss", "mode"),
-)
-def _run_pushpull(
+def _pushpull_scan(
     dg: DeviceGraph,
     origins: jnp.ndarray,
     gen_ticks: jnp.ndarray,
@@ -74,6 +70,14 @@ def _run_pushpull(
     loss: tuple | None = None,
     mode: str = "pushpull",           # "pushpull" | "pull"
 ):
+    """The one round loop behind both execution forms: the solo jit
+    (`_run_pushpull`, static loss seed) and the campaign's replica vmap
+    (`_run_pushpull_replicas`, traced per-replica seed/loss-seed). The
+    vmapped form is bitwise-identical per replica BECAUSE it batches this
+    exact computation — all ops are integer/bitwise and the argsort
+    inside `scatter_or` is stable, so adding a batch axis changes no
+    element. ``loss`` is (static threshold, seed) where the seed may be a
+    traced uint32 scalar (models/linkloss.py)."""
     n, w = dg.n, bitmask.num_words(chunk_size)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
     ring = dg.ring_size
@@ -132,7 +136,7 @@ def _run_pushpull(
         if mode == "pull":
             pushed = jnp.uint32(0)
         else:
-            pushed = scatter_or(
+            pushed = scatter_or_auto(
                 n, partners, jnp.where(push_ok[:, None], my_old, jnp.uint32(0))
             )
         gen_active = gen_ticks == t
@@ -177,6 +181,80 @@ def _run_pushpull(
     )
     seen, _, received, sent_lo, sent_hi = state
     return seen, received, (sent_lo, sent_hi), coverage
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("chunk_size", "horizon", "record_coverage", "loss", "mode"),
+)
+def _run_pushpull(
+    dg: DeviceGraph,
+    origins: jnp.ndarray,
+    gen_ticks: jnp.ndarray,
+    seed: jnp.ndarray,
+    partners_override: jnp.ndarray,
+    churn=None,
+    *,
+    chunk_size: int,
+    horizon: int,
+    record_coverage: bool = False,
+    loss: tuple | None = None,
+    mode: str = "pushpull",
+):
+    """Solo jit of `_pushpull_scan` — the static-loss-seed path the chunk
+    driver (`_run_partnered_sim`) calls; kept bitwise-stable while the
+    campaign engine batches the same scan with traced seeds."""
+    return _pushpull_scan(
+        dg, origins, gen_ticks, seed, partners_override, churn,
+        chunk_size=chunk_size, horizon=horizon,
+        record_coverage=record_coverage, loss=loss, mode=mode,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "chunk_size", "horizon", "record_coverage", "loss_threshold", "mode",
+    ),
+)
+def _run_pushpull_replicas(
+    dg: DeviceGraph,
+    origins_b: jnp.ndarray,     # (B, S) int32
+    gen_ticks_b: jnp.ndarray,   # (B, S) int32
+    seeds_b: jnp.ndarray,       # (B,) uint32 — per-replica partner streams
+    loss_seeds_b: jnp.ndarray,  # (B,) uint32 — per-replica erasure streams
+    churn_b=None,               # optional ((B, N, K), (B, N, K))
+    *,
+    chunk_size: int,
+    horizon: int,
+    record_coverage: bool = False,
+    loss_threshold: int = 0,    # 0 = loss off (loss_seeds_b then unused)
+    mode: str = "pushpull",
+):
+    """Replica batch of the anti-entropy round loop: ``vmap`` of
+    `_pushpull_scan` over (schedule, partner seed, loss seed, churn).
+    The graph/delay model is shared (closed over); the loss THRESHOLD is
+    shared static config while the loss seed rides the batch axis, so
+    each replica draws an independent erasure stream. The scan (fixed
+    trip count) batches cleanly — none of the batched-while select
+    overhead the flood campaign avoids in `batch/campaign.py`."""
+    override = jnp.zeros((0,), dtype=jnp.int32)
+
+    def one(origins, gen_ticks, seed, lseed, churn):
+        loss = (loss_threshold, lseed) if loss_threshold > 0 else None
+        return _pushpull_scan(
+            dg, origins, gen_ticks, seed, override, churn,
+            chunk_size=chunk_size, horizon=horizon,
+            record_coverage=record_coverage, loss=loss, mode=mode,
+        )
+
+    if churn_b is None:
+        return jax.vmap(lambda o, g, s, l: one(o, g, s, l, None))(
+            origins_b, gen_ticks_b, seeds_b, loss_seeds_b
+        )
+    return jax.vmap(one)(
+        origins_b, gen_ticks_b, seeds_b, loss_seeds_b, churn_b
+    )
 
 
 def run_pushpull_sim(
@@ -255,7 +333,15 @@ def _check_pull_credit_bound(graph: Graph, chunk_size: int, schedule) -> None:
     full chunk); the uint32 scatter accumulator wraps at 2^32. Enforce the
     exact precondition instead of silently corrupting ``sent``."""
     eff_chunk = min(chunk_size, max(MIN_CHUNK_SHARES, schedule.num_shares))
-    eff_chunk = bitmask.num_words(eff_chunk) * bitmask.WORD_BITS
+    check_pull_credit_width(
+        graph, bitmask.num_words(eff_chunk) * bitmask.WORD_BITS
+    )
+
+
+def check_pull_credit_width(graph: Graph, eff_chunk: int) -> None:
+    """The bound itself, for callers that already know their exact pass
+    width (the campaign engine's packed pad differs from the solo
+    formula)."""
     if int(graph.max_degree) * eff_chunk >= 1 << 32:
         raise PullCreditBoundError(
             "pull-mode per-round sent credit may overflow uint32: "
@@ -484,11 +570,7 @@ def _select_fanout_partners(
     return ell_idx[rows, kidx], ell_delay[rows, kidx]
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("fanout", "chunk_size", "horizon", "record_coverage", "loss"),
-)
-def _run_pushk(
+def _pushk_scan(
     dg: DeviceGraph,
     origins: jnp.ndarray,
     gen_ticks: jnp.ndarray,
@@ -502,6 +584,9 @@ def _run_pushk(
     record_coverage: bool = False,
     loss: tuple | None = None,
 ):
+    """Fanout-push round loop shared by the solo jit (`_run_pushk`) and
+    the campaign replica vmap (`_run_pushk_replicas`) — same
+    batch-safety contract as `_pushpull_scan`."""
     n, w = dg.n, bitmask.num_words(chunk_size)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
     ring = dg.ring_size
@@ -551,7 +636,7 @@ def _run_pushk(
                 rows[:, None], partners, t, thr, lseed
             )
         payload_ok = jnp.where(push_ok[..., None], payload, jnp.uint32(0))
-        incoming = scatter_or(
+        incoming = scatter_or_auto(
             n, partners.reshape(-1), payload_ok.reshape(n * fanout, w)
         )
         # The sender counts every attempted pick (loss drops in flight);
@@ -583,6 +668,73 @@ def _run_pushk(
     )
     seen, _, received, sent_lo, sent_hi = state
     return seen, received, (sent_lo, sent_hi), coverage
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("fanout", "chunk_size", "horizon", "record_coverage", "loss"),
+)
+def _run_pushk(
+    dg: DeviceGraph,
+    origins: jnp.ndarray,
+    gen_ticks: jnp.ndarray,
+    seed: jnp.ndarray,
+    partners_override: jnp.ndarray,
+    churn=None,
+    *,
+    fanout: int,
+    chunk_size: int,
+    horizon: int,
+    record_coverage: bool = False,
+    loss: tuple | None = None,
+):
+    """Solo jit of `_pushk_scan` (static loss seed) — see `_run_pushpull`."""
+    return _pushk_scan(
+        dg, origins, gen_ticks, seed, partners_override, churn,
+        fanout=fanout, chunk_size=chunk_size, horizon=horizon,
+        record_coverage=record_coverage, loss=loss,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "fanout", "chunk_size", "horizon", "record_coverage", "loss_threshold",
+    ),
+)
+def _run_pushk_replicas(
+    dg: DeviceGraph,
+    origins_b: jnp.ndarray,     # (B, S) int32
+    gen_ticks_b: jnp.ndarray,   # (B, S) int32
+    seeds_b: jnp.ndarray,       # (B,) uint32
+    loss_seeds_b: jnp.ndarray,  # (B,) uint32
+    churn_b=None,               # optional ((B, N, K), (B, N, K))
+    *,
+    fanout: int,
+    chunk_size: int,
+    horizon: int,
+    record_coverage: bool = False,
+    loss_threshold: int = 0,
+):
+    """Replica batch of fanout push — the pushk leg of
+    `_run_pushpull_replicas`'s contract."""
+    override = jnp.zeros((0,), dtype=jnp.int32)
+
+    def one(origins, gen_ticks, seed, lseed, churn):
+        loss = (loss_threshold, lseed) if loss_threshold > 0 else None
+        return _pushk_scan(
+            dg, origins, gen_ticks, seed, override, churn,
+            fanout=fanout, chunk_size=chunk_size, horizon=horizon,
+            record_coverage=record_coverage, loss=loss,
+        )
+
+    if churn_b is None:
+        return jax.vmap(lambda o, g, s, l: one(o, g, s, l, None))(
+            origins_b, gen_ticks_b, seeds_b, loss_seeds_b
+        )
+    return jax.vmap(one)(
+        origins_b, gen_ticks_b, seeds_b, loss_seeds_b, churn_b
+    )
 
 
 def run_pushk_sim(

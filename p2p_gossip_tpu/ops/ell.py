@@ -68,14 +68,19 @@ def _pad_degree_axis(arr: jnp.ndarray, block: int, fill) -> jnp.ndarray:
     return arr
 
 
-def _loss_keep(b_idx, dst_ids, tick, loss):
+def _loss_keep(b_idx, dst_ids, tick, loss, loss_seed=None):
     """(N_out, B) bool: True where the directed link (src=b_idx -> dst) is
     NOT suffering a loss-model erasure at arrival tick ``tick``
     (models/linkloss.py spec). ``loss`` is the static (threshold, seed)
-    pair."""
+    pair; ``loss_seed`` (optional traced uint32 scalar) overrides the
+    static seed — the per-replica erasure streams of the campaign engine,
+    where the seed must be a vmapped operand, not a compile-time
+    constant. Identical coins either way (same hash)."""
     from p2p_gossip_tpu.models.linkloss import drop_mask_jnp
 
     threshold, seed = loss
+    if loss_seed is not None:
+        seed = loss_seed
     return ~drop_mask_jnp(b_idx, dst_ids[:, None], tick, threshold, seed)
 
 
@@ -91,6 +96,7 @@ def propagate(
     block: int = DEFAULT_DEGREE_BLOCK,
     loss: tuple | None = None,
     dst_ids: jnp.ndarray | None = None,
+    loss_seed: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Returns arrivals: (N_out, W) uint32 — shares arriving per tick.
 
@@ -102,7 +108,9 @@ def propagate(
     ``loss`` = (threshold, seed) enables the per-link erasure model
     (models/linkloss.py); ``dst_ids`` gives the global node id of each of
     the N_out rows (defaults to 0..N_out-1 — pass explicitly whenever rows
-    are a shard or bucket of the global graph).
+    are a shard or bucket of the global graph). ``loss_seed`` (traced
+    uint32 scalar) overrides the static seed so each campaign replica can
+    draw an independent erasure stream (see _loss_keep).
     """
     d, n_src, w = hist.shape
     n_out = ell_idx.shape[0]
@@ -126,7 +134,7 @@ def propagate(
         gathered = flat[slot * n_src + b_idx]  # (N_out, B, W)
         keep = b_msk
         if loss is not None:
-            keep = keep & _loss_keep(b_idx, dst_ids, tick, loss)
+            keep = keep & _loss_keep(b_idx, dst_ids, tick, loss, loss_seed)
         gathered = jnp.where(keep[..., None], gathered, jnp.uint32(0))
         acc = acc | lax.reduce(
             gathered, jnp.uint32(0), lax.bitwise_or, (1,)
@@ -148,6 +156,7 @@ def gather_or_frontier(
     block: int = DEFAULT_DEGREE_BLOCK,
     loss: tuple | None = None,
     dst_ids: jnp.ndarray | None = None,
+    loss_seed: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """OR-gather arrivals from a single source frontier.
 
@@ -157,7 +166,7 @@ def gather_or_frontier(
     is a pure (N_out, dmax)-edge gather-OR over one (N_src, W) array.
     ``tick`` is the ARRIVAL tick — the loss coin hashes (src, dst, t), so
     it must be the same t every engine uses, regardless of which past
-    slice is being read."""
+    slice is being read. ``loss_seed`` as in `propagate`."""
     n_out = ell_idx.shape[0]
     w = frontier.shape[-1]
     if loss is not None and dst_ids is None:
@@ -174,7 +183,7 @@ def gather_or_frontier(
         gathered = frontier[b_idx]  # (N_out, B, W)
         keep = b_msk
         if loss is not None:
-            keep = keep & _loss_keep(b_idx, dst_ids, tick, loss)
+            keep = keep & _loss_keep(b_idx, dst_ids, tick, loss, loss_seed)
         gathered = jnp.where(keep[..., None], gathered, jnp.uint32(0))
         acc = acc | lax.reduce(gathered, jnp.uint32(0), lax.bitwise_or, (1,))
         return acc, None
@@ -198,17 +207,20 @@ def propagate_uniform(
     block: int = DEFAULT_DEGREE_BLOCK,
     loss: tuple | None = None,
     dst_ids: jnp.ndarray | None = None,
+    loss_seed: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Fast path for a uniform per-edge delay (the reference's constant-link
     -latency model): the delay-line slot is one scalar per tick, so the
     per-edge delay gather — and the whole (N, dmax) delay array read from
-    HBM — disappears. ``loss``/``dst_ids`` as in `propagate`."""
+    HBM — disappears. ``loss``/``dst_ids``/``loss_seed`` as in
+    `propagate`."""
     d = hist.shape[0]
     assert d == ring_size
     # One source frontier for the whole tick.
     src = hist[jnp.mod(tick - uniform_delay, ring_size)]  # (N_src, W)
     return gather_or_frontier(
-        src, tick, ell_idx, ell_mask, block=block, loss=loss, dst_ids=dst_ids
+        src, tick, ell_idx, ell_mask, block=block, loss=loss, dst_ids=dst_ids,
+        loss_seed=loss_seed,
     )
 
 
@@ -465,13 +477,14 @@ def propagate_bucketed(
     uniform_delay: int | None = None,
     block: int = DEFAULT_DEGREE_BLOCK,
     loss: tuple | None = None,
+    loss_seed: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Gather-OR over degree buckets (see `build_degree_buckets`).
 
     Bitwise-identical to `propagate`/`propagate_uniform` on the full ELL —
     each bucket computes its rows' arrivals over its own (tight) ELL and the
-    results are scattered back into node order. ``loss`` as in `propagate`
-    (each bucket's global row ids are its dst_ids).
+    results are scattered back into node order. ``loss``/``loss_seed`` as
+    in `propagate` (each bucket's global row ids are its dst_ids).
     """
     w = hist.shape[-1]
     parts = []
@@ -484,12 +497,14 @@ def propagate_bucketed(
                 hist, tick, b_idx, b_mask,
                 ring_size=ring_size, uniform_delay=uniform_delay,
                 block=b_block, loss=loss, dst_ids=rows if loss else None,
+                loss_seed=loss_seed,
             )
         else:
             part = propagate(
                 hist, tick, b_idx, b_delay, b_mask,
                 ring_size=ring_size, block=b_block,
                 loss=loss, dst_ids=rows if loss else None,
+                loss_seed=loss_seed,
             )
         parts.append(part)
     # One combined scatter back to node order (the rows arrays partition

@@ -1,12 +1,24 @@
-"""Scatter-OR via sort + segmented OR-scan.
+"""Scatter-OR: sort + segmented OR-scan, with a narrow-row bit variant.
 
 XLA has scatter-add/min/max but no scatter-OR, and bitmask rows can't ride
-scatter-max. The TPU-idiomatic construction: sort payload rows by destination,
-OR-reduce each run of equal destinations with a segmented associative scan,
-and write one row per distinct destination (collision-free, so a plain
-scatter suffices). O(N log N) sort + O(N) scan per call — all dense,
-XLA-friendly ops. Used by the push direction of push-pull anti-entropy
-(models/protocols.py).
+scatter-max. Two exact constructions, picked by row width:
+
+- `scatter_or` (the default): sort payload rows by destination, OR-reduce
+  each run of equal destinations with a segmented associative scan, and
+  write one row per distinct destination (collision-free, so a plain
+  scatter suffices). O(M log M) sort + O(M) scan per call — all dense,
+  XLA-friendly ops, and width-insensitive (the sort moves int32 keys).
+- `scatter_or_bits`: unpack each uint32 word to 32 int lanes, scatter-ADD
+  them (XLA-native, collision-safe), and repack ``> 0`` — OR as a
+  saturating sum. Work scales with ``W x 32`` lanes, so it only wins on
+  narrow rows, but there it removes the sort+scan critically: at the
+  campaign engine's packed pads (W <= 2) it measured ~2x faster per
+  round on CPU (B=32 x N=1024), which is most of the batched push
+  protocols' round cost.
+
+Both compute the same exact OR — callers may switch per shape
+(`scatter_or_auto`) without changing a single result bit. Used by the
+push directions of the anti-entropy protocols (models/protocols.py).
 """
 
 from __future__ import annotations
@@ -14,6 +26,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+#: Row widths (uint32 words) at or below which the bit-unpack scatter-add
+#: beats the sort + segmented scan. Swept on CPU at B=32 x M=1024:
+#: bits wins 2x at W=1-2, ties at W=4, loses 2.4x at W=8 — the unpack's
+#: 32x lane inflation overtakes the sort's fixed cost right around the
+#: 128-bit row.
+SCATTER_OR_BITS_MAX_WORDS = 2
 
 
 def scatter_or(
@@ -56,3 +75,43 @@ def scatter_or(
     out = jnp.zeros((n_rows + 1, w), dtype=jnp.uint32)
     out = out.at[rows].max(jnp.where(tails[:, None], vals, jnp.uint32(0)))
     return out[:n_rows]
+
+
+def scatter_or_bits(
+    n_rows: int,
+    dst: jnp.ndarray,     # (M,) int32 destination row per payload
+    payload: jnp.ndarray, # (M, W) uint32 rows to OR into dst
+    mask: jnp.ndarray | None = None,  # (M,) bool — inactive entries dropped
+) -> jnp.ndarray:
+    """Exact scatter-OR via per-bit scatter-ADD (see module docstring).
+    Bitwise-identical output to `scatter_or`; only profitable for narrow
+    rows (W <= SCATTER_OR_BITS_MAX_WORDS)."""
+    _, w = payload.shape
+    if mask is not None:
+        # Same sentinel-row trick as scatter_or: inactive entries land on
+        # row n_rows, which is sliced away.
+        dst = jnp.where(mask, dst, n_rows)
+    shifts = jnp.arange(32, dtype=jnp.uint32)[None, None, :]
+    bits = ((payload[:, :, None] >> shifts) & jnp.uint32(1)).astype(jnp.int32)
+    acc = jnp.zeros((n_rows + 1, w, 32), dtype=jnp.int32).at[dst].add(bits)
+    words = jnp.sum(
+        (acc > 0).astype(jnp.uint32) << shifts, axis=2, dtype=jnp.uint32
+    )
+    return words[:n_rows]
+
+
+def scatter_or_auto(
+    n_rows: int,
+    dst: jnp.ndarray,
+    payload: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Width-dispatched exact scatter-OR: the bit scatter-add on narrow
+    rows, sort + segmented scan otherwise. The width is static at trace
+    time, so the dispatch costs nothing compiled."""
+    impl = (
+        scatter_or_bits
+        if payload.shape[1] <= SCATTER_OR_BITS_MAX_WORDS
+        else scatter_or
+    )
+    return impl(n_rows, dst, payload, mask)

@@ -240,8 +240,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="Monte-Carlo campaign: run R seed-ensemble replicas of the "
         "simulation inside one jit (batch/campaign.py) and report "
         "ensemble statistics (ttc percentiles, counter CIs) instead of "
-        "one run's numbers. Replica r uses seed (--seed + r); --backend "
-        "tpu --protocol push only (with or without --floodCoverage)",
+        "one run's numbers. Replica r uses seed (--seed + r), including "
+        "its own link-loss stream under --lossProb; every --protocol "
+        "rides the vmapped engine (push floods; pushpull/pull/pushk "
+        "batch the anti-entropy round loop). --backend tpu only; "
+        "composes with --floodCoverage and --checkpoint",
     )
     p.add_argument(
         "--sweep", type=str, default="", metavar="SPEC.json",
@@ -449,10 +452,12 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
 
 def _run_campaign_cli(args, g, horizon, delays, loss) -> int:
     """--replicas R: a seed-ensemble campaign in one jit. Replica r's
-    schedule and churn derive from seed (--seed + r) with the solo CLI's
-    stream offsets, so any single replica is reproducible as a solo run;
-    the link-loss model is drawn once from the base seed (a campaign-
-    level config, like the graph). Reports ensemble statistics — the
+    schedule, churn AND link-loss stream derive from seed (--seed + r)
+    with the solo CLI's stream offsets (+7919 churn, +104729 loss), so
+    any single replica is bitwise-reproducible as a solo ``--seed
+    (--seed + r)`` run. Every protocol batches: push through the flood
+    campaign kernels, pushpull/pull/pushk through
+    ``run_protocol_campaign``. Reports ensemble statistics — the
     distribution a single-seed run cannot show."""
     import json
 
@@ -461,31 +466,57 @@ def _run_campaign_cli(args, g, horizon, delays, loss) -> int:
         gossip_replicas,
         run_coverage_campaign,
         run_gossip_campaign,
+        run_protocol_campaign,
     )
     from p2p_gossip_tpu.batch.stats import ensemble_summary
+    from p2p_gossip_tpu.models.protocols import PullCreditBoundError
 
     seeds = [args.seed + r for r in range(args.replicas)]
+    # Per-replica erasure streams: the same +104729 offset the solo CLI
+    # applies to --seed, one per replica seed.
+    loss_seeds = (
+        [s + 104729 for s in seeds] if loss is not None else None
+    )
+    ckpt_kw = dict(
+        checkpoint_path=args.checkpoint or None,
+        checkpoint_every=args.checkpointEvery,
+    )
     churn_kw = dict(
         churn_prob=args.churnProb,
         mean_down_ticks=max(args.churnDowntime / (args.Latency / 1000.0), 1.0),
         max_outages=args.churnOutages,
     )
+    partnered = args.protocol in ("pushpull", "pull", "pushk")
     if args.floodCoverage:
         replicas = flood_replicas(
             g, args.floodCoverage, seeds, horizon, **churn_kw
-        )
-        result = run_coverage_campaign(
-            g, replicas, horizon, ell_delays=delays, loss=loss,
-            block=args.degreeBlock or None,
         )
     else:
         replicas = gossip_replicas(
             g, args.simTime, args.Latency / 1000.0, seeds, horizon,
             gen_lo=args.genLo, gen_hi=args.genHi, **churn_kw,
         )
+    if partnered:
+        try:
+            result = run_protocol_campaign(
+                g, replicas, horizon, protocol=args.protocol,
+                fanout=args.fanout, ell_delays=delays, loss=loss,
+                loss_seeds=loss_seeds,
+                record_coverage=bool(args.floodCoverage), **ckpt_kw,
+            )
+        except PullCreditBoundError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    elif args.floodCoverage:
+        result = run_coverage_campaign(
+            g, replicas, horizon, ell_delays=delays, loss=loss,
+            loss_seeds=loss_seeds, block=args.degreeBlock or None, **ckpt_kw,
+        )
+    else:
         result = run_gossip_campaign(
             g, replicas, horizon, ell_delays=delays, loss=loss,
-            chunk_size=args.chunkSize, block=args.degreeBlock or None,
+            loss_seeds=loss_seeds, chunk_size=args.chunkSize,
+            block=args.degreeBlock or None, **ckpt_kw,
         )
     summary = ensemble_summary(result, args.coverageFraction)
 
@@ -960,19 +991,20 @@ def run(argv=None) -> int:
         )
         return 2
     if args.replicas > 1:
-        # The campaign engine vmaps the single-device sync flood path;
-        # partnered protocols and the other backends run ensembles via
-        # the sweep runner (--sweep) until they grow a vmap axis.
-        if args.backend != "tpu" or args.protocol != "push":
+        # The campaign engine vmaps the single-device engines: the sync
+        # flood path for --protocol push, the anti-entropy round scan for
+        # pushpull/pull/pushk (batch/campaign.py). Other backends run
+        # ensembles via the sweep runner (--sweep).
+        if args.backend != "tpu":
             print(
-                "error: --replicas requires --backend tpu --protocol push "
-                "(use --sweep for partnered-protocol ensembles)",
+                "error: --replicas requires --backend tpu (the vmapped "
+                "campaign engine; use --sweep for other-backend ensembles)",
                 file=sys.stderr,
             )
             return 2
-        if args.checkpoint or args.anim:
+        if args.anim:
             print(
-                "error: --replicas does not support --checkpoint/--anim "
+                "error: --replicas does not support --anim "
                 "(per-replica artifacts are a sweep-runner concern)",
                 file=sys.stderr,
             )

@@ -132,6 +132,44 @@ def main() -> int:
         )
         print()
 
+    camp = by_stage.get("campaign")
+    if camp and camp["results"]:
+        cells = [r for r in camp["results"] if "cell" in r]
+        if cells:
+            print("## Campaign engine (vmapped seed ensembles)\n")
+            print(md_table(
+                [
+                    {
+                        "protocol": c["cell"].get("protocol"),
+                        "engine": c.get("engine"),
+                        "platform": c.get("platform"),
+                        "replicas": len(c.get("seeds", [])),
+                        "lossProb": c["cell"].get("lossProb"),
+                        "ttc_p50": ((c.get("summary", {}).get("ttc") or {})
+                                    .get("ticks") or {}).get("p50"),
+                        "wall_s": c.get("wall_s"),
+                    }
+                    for c in cells
+                ],
+                ["protocol", "engine", "platform", "replicas", "lossProb",
+                 "ttc_p50", "wall_s"],
+            ))
+            print()
+        cmps = [
+            r["compare_sequential"]
+            for r in camp["results"]
+            if isinstance(r.get("compare_sequential"), dict)
+        ]
+        if cmps:
+            print("## Campaign vs sequential-per-seed\n")
+            print(md_table(cmps, [
+                "protocol", "replicas", "sequential_wall_s",
+                "warm_loop_wall_s", "campaign_wall_s",
+                "campaign_warm_wall_s", "speedup_vs_sequential",
+                "speedup_vs_warm_loop", "speedup_warm_vs_warm_loop",
+            ]))
+            print()
+
     kernel_rows = []
     for stage in ("kernel", "sweep250"):
         rec = by_stage.get(stage)

@@ -17,6 +17,15 @@ Stages (value-first within safety bands — see the note after the list):
   bench_rep3 — bench.py again                   three records distinguish
                drift from noise (round-1 5.60e8 vs round-4 4.41e8 was
                undecidable from singles); cheap (~90 s each) and safe.
+  campaign  — sweep.py over the acceptance campaign spec (all four
+               protocols at R=32 x N=1024, --compare-sequential) -> the
+               first HARDWARE record of the vmapped campaign kernels
+               (flood + the batched Demers trio) and their measured
+               speedup vs sequential-per-seed; standard XLA (vmap of the
+               already-validated engines), so it sits in the safe band
+               before any 1M or Pallas stage. Today's campaign numbers
+               are CPU-only (docs/artifacts/campaign_accept_cpu.jsonl,
+               protocol_campaign_accept_cpu.jsonl).
   scale1m   — scale_1m.py --shares 64 --chunk 64 -> the 1M ER on-chip
                line at the minimal resident footprint (pad W=2, ~5.2 GB
                modeled = essentially the bare ELL). The full-config
@@ -91,6 +100,7 @@ ART_DIR = os.path.join(REPO, "docs", "artifacts")
 
 STAGE_ORDER = (
     "bench", "protocols", "kernel", "bench_rep2", "bench_rep3",
+    "campaign",
     "scale1m", "scale1m_ba", "sweep250", "profile", "scale1m_full",
 )
 
@@ -164,6 +174,18 @@ def stage_specs(args) -> dict:
                 "argv": kb_small + ["--skip-gather"],
                 "env": cpu,
                 "budget": args.stage_budget or 600,
+            },
+            "campaign": {
+                # The built-in example spec (2 protocols x 2 loss rates x
+                # 8 seeds at 256 nodes): exercises the vmapped campaign
+                # path end to end, including one sequential comparison
+                # per protocol, at CPU-smoke scale.
+                "argv": [
+                    py, os.path.join(SCRIPTS, "sweep.py"),
+                    "--example", "--compare-sequential", "--no-report",
+                ],
+                "env": cpu,
+                "budget": args.stage_budget or 900,
             },
             "profile": {
                 # --art-dir follows the battery's own artifact dir so a
@@ -261,6 +283,21 @@ def stage_specs(args) -> dict:
             "argv": kb + ["--rows", "250000"],
             "env": sweep_env,
             "budget": args.stage_budget or 2700,
+        },
+        "campaign": {
+            # The acceptance campaign spec (all four protocols at
+            # R=32 x N=1024 with --compare-sequential): first hardware
+            # validation of the vmapped campaign kernels and the packed
+            # share pad, with the per-protocol sequential speedups as
+            # stdout JSON lines. Standard XLA ops only.
+            "argv": [
+                py, os.path.join(SCRIPTS, "sweep.py"),
+                "--sweep", os.path.join(REPO, "examples",
+                                        "campaign_accept.json"),
+                "--compare-sequential", "--no-report",
+            ],
+            "env": sweep_env,
+            "budget": args.stage_budget or 1800,
         },
         "profile": {
             # One profiled bench pass + trace parse. --art-dir follows

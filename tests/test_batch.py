@@ -260,9 +260,9 @@ def test_sweep_grid_expansion_and_validation():
 
 
 def test_sweep_records_contract():
-    """One strict-JSON record per cell with ttc percentiles and CIs; the
-    partnered protocol rides the sequential path with honest labels, and
-    the report renders."""
+    """One strict-JSON record per cell with ttc percentiles and CIs;
+    every protocol — partnered ones included — rides the vmapped engine
+    with honest labels, and the report renders."""
     from p2p_gossip_tpu.batch.sweep import run_sweep
 
     spec = {
@@ -286,6 +286,6 @@ def test_sweep_records_contract():
         assert s["counters"]["received"]["ci95"] is not None
     by_proto = {r["cell"]["protocol"]: r for r in records}
     assert by_proto["push"]["engine"] == "vmap"
-    assert by_proto["pushk"]["engine"] == "sequential"
+    assert by_proto["pushk"]["engine"] == "vmap"
     report = format_campaign_report(records)
     assert "push" in report and "pushk" in report and "ttc p50" in report

@@ -555,9 +555,37 @@ def test_replicas_campaign_cli_validation():
     )
     assert r.returncode == 2 and "--backend tpu" in r.stderr
     r = _run_cli(
-        "--numNodes", "16", "--replicas", "2", "--protocol", "pushk",
+        "--numNodes", "16", "--replicas", "2", "--anim", "/tmp/x.xml",
     )
-    assert r.returncode == 2 and "--sweep" in r.stderr
+    assert r.returncode == 2 and "--anim" in r.stderr
+
+
+def test_replicas_protocol_campaign_cli(tmp_path):
+    """--replicas now covers the partnered protocols, and composes with
+    --checkpoint: the second invocation resumes from the snapshot and
+    reports identical ensemble statistics."""
+    import json
+
+    ck = str(tmp_path / "camp.npz")
+    common = (
+        "--numNodes", "64", "--connectionProb", "0.1", "--simTime", "1",
+        "--Latency", "5", "--backend", "tpu", "--floodCoverage", "2",
+        "--replicas", "3", "--seed", "4", "--protocol", "pushpull",
+        "--lossProb", "0.1", "--json", "--checkpoint", ck,
+    )
+    r = _run_cli(*common)
+    assert r.returncode == 0, r.stderr
+    assert "=== Campaign: 3 replicas x 2 flood shares" in r.stdout
+    row = json.loads(r.stdout.strip().splitlines()[-1])
+    assert row["config"]["protocol"] == "pushpull"
+    import os
+
+    assert os.path.exists(ck)  # the snapshot landed
+    r2 = _run_cli(*common)  # resumes from it (fingerprint match)
+    assert r2.returncode == 0, r2.stderr
+    row2 = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert row2["summary"]["counters"] == row["summary"]["counters"]
+    assert row2["summary"]["ttc"] == row["summary"]["ttc"]
 
 
 def test_sweep_cli(tmp_path):

@@ -1,0 +1,367 @@
+"""Vmapped protocol campaign tests (batch.campaign.run_protocol_campaign).
+
+The load-bearing contract mirrors the flood campaign's: replica *i* of a
+vmapped pushpull/pull/pushk campaign is bitwise-identical to a solo
+``models.protocols`` run with the same seed — counters AND coverage,
+including under link loss and churn. Plus: per-replica loss streams
+(independence and solo reproducibility), batch-boundary checkpoint
+resume-equivalence, batch/share chunking invariance, and the sweep's
+engine-labeling + cross-engine record-schema contract.
+
+Tier-1 SAMPLES one failure-model combination per protocol; the
+exhaustive grid rides the ``slow`` marker.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import p2p_gossip_tpu as pg
+from p2p_gossip_tpu.batch.campaign import (
+    flood_replicas,
+    run_coverage_campaign,
+    run_protocol_campaign,
+)
+from p2p_gossip_tpu.models.generation import Schedule
+from p2p_gossip_tpu.models.linkloss import LinkLossModel
+from p2p_gossip_tpu.models.protocols import run_pushk_sim, run_pushpull_sim
+
+
+def _solo(graph, proto, seed, shares, horizon, fanout=2, churn=None,
+          loss=None):
+    """The exact solo reference of one campaign replica: flood-style
+    origins from the replica seed (the sweep/CLI stream), solo engine."""
+    origins = (
+        np.random.default_rng(int(seed))
+        .integers(0, graph.n, shares)
+        .astype(np.int32)
+    )
+    sched = Schedule(graph.n, origins, np.zeros(shares, dtype=np.int32))
+    if proto == "pushk":
+        return run_pushk_sim(
+            graph, sched, horizon, fanout=fanout, seed=int(seed),
+            churn=churn, loss=loss, record_coverage=True,
+        )
+    return run_pushpull_sim(
+        graph, sched, horizon, seed=int(seed), churn=churn, loss=loss,
+        record_coverage=True, mode=proto,
+    )
+
+
+def _assert_replica_parity(res, graph, proto, reps, loss, horizon, s,
+                           fanout=2, loss_seeds=None):
+    for r in range(reps.num_replicas):
+        rloss = (
+            loss
+            if loss_seeds is None or loss is None
+            else LinkLossModel(loss.prob, seed=int(loss_seeds[r]))
+        )
+        stats, cov = _solo(
+            graph, proto, reps.seeds[r], s, horizon, fanout=fanout,
+            churn=reps.replica_churn(r), loss=rloss,
+        )
+        np.testing.assert_array_equal(stats.received, res.received[r])
+        np.testing.assert_array_equal(stats.sent, res.sent[r])
+        np.testing.assert_array_equal(stats.generated, res.generated[r])
+        np.testing.assert_array_equal(cov[:horizon, :s], res.coverage[r])
+
+
+@pytest.mark.parametrize("proto", ["pushpull", "pull", "pushk"])
+def test_protocol_campaign_bitwise_parity_loss_and_churn(proto):
+    """The acceptance anchor, hard mode per protocol: R=5 replicas under
+    churn + (cell-shared) link loss equal their solo runs bitwise."""
+    g = pg.erdos_renyi(96, 0.08, seed=0)
+    horizon, s = 28, 3
+    reps = flood_replicas(
+        g, s, list(range(5)), horizon, churn_prob=0.4, mean_down_ticks=8
+    )
+    loss = LinkLossModel(0.2, seed=104729)
+    res = run_protocol_campaign(
+        g, reps, horizon, protocol=proto, fanout=3, loss=loss
+    )
+    _assert_replica_parity(res, g, proto, reps, loss, horizon, s, fanout=3)
+    # Anti-entropy counter law (check_conservation's flood send law does
+    # not apply here): received == forwarded for every replica.
+    stats0 = res.replica_stats(0)
+    np.testing.assert_array_equal(stats0.received, stats0.forwarded)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("proto", ["pushpull", "pull", "pushk"])
+@pytest.mark.parametrize(
+    "kw",
+    [dict(), dict(churn=True), dict(loss=True)],
+    ids=["plain", "churn", "loss"],
+)
+def test_protocol_campaign_bitwise_parity_grid(proto, kw):
+    """Exhaustive failure-model grid — tier-1 samples only the combined
+    case above."""
+    g = pg.erdos_renyi(80, 0.09, seed=3)
+    horizon, s = 24, 2
+    reps = flood_replicas(
+        g, s, [2, 9, 17], horizon,
+        churn_prob=0.5 if kw.get("churn") else 0.0, mean_down_ticks=6,
+    )
+    loss = LinkLossModel(0.25, seed=7) if kw.get("loss") else None
+    res = run_protocol_campaign(
+        g, reps, horizon, protocol=proto, loss=loss
+    )
+    _assert_replica_parity(res, g, proto, reps, loss, horizon, s)
+
+
+def test_protocol_campaign_per_replica_loss_streams():
+    """``loss_seeds``: replica r equals a solo run with
+    ``LinkLossModel(prob, seed=loss_seeds[r])`` bitwise, and replicas
+    with identical schedules + partner streams but different loss seeds
+    diverge — the erasure streams are genuinely independent."""
+    g = pg.erdos_renyi(96, 0.08, seed=1)
+    horizon, s = 24, 3
+    # Identical replica seeds => identical schedules AND partner picks:
+    # any cross-replica difference below is the loss stream's alone.
+    reps = flood_replicas(g, s, [5, 5, 5], horizon)
+    loss = LinkLossModel(0.3, seed=0)
+    lseeds = [11, 11, 999]
+    res = run_protocol_campaign(
+        g, reps, horizon, protocol="pushpull", loss=loss, loss_seeds=lseeds
+    )
+    _assert_replica_parity(
+        res, g, "pushpull", reps, loss, horizon, s, loss_seeds=lseeds
+    )
+    # Same loss seed -> identical rows; different -> diverging coverage.
+    np.testing.assert_array_equal(res.received[0], res.received[1])
+    np.testing.assert_array_equal(res.coverage[0], res.coverage[1])
+    assert not np.array_equal(res.coverage[0], res.coverage[2])
+    # The flood campaign threads the same per-replica streams through the
+    # gather (ops/ell.py traced loss seed).
+    fres = run_coverage_campaign(
+        g, reps, horizon, loss=loss, loss_seeds=lseeds, chunk_size=64
+    )
+    np.testing.assert_array_equal(fres.received[0], fres.received[1])
+    assert not np.array_equal(fres.coverage[0], fres.coverage[2])
+    with pytest.raises(ValueError, match="loss model"):
+        run_protocol_campaign(
+            g, reps, horizon, protocol="pushpull", loss_seeds=lseeds
+        )
+    with pytest.raises(ValueError, match="one seed per replica"):
+        run_protocol_campaign(
+            g, reps, horizon, protocol="pushpull", loss=loss,
+            loss_seeds=[1, 2],
+        )
+
+
+def test_protocol_campaign_batch_and_share_chunking_invariance():
+    """batch_size slicing (with sentinel padding) and share chunking must
+    not change a single bit of any output tensor."""
+    g = pg.erdos_renyi(64, 0.1, seed=2)
+    horizon, s = 20, 70  # s > chunk 32 forces multiple share chunks
+    reps = flood_replicas(g, s, list(range(5)), horizon)
+    whole = run_protocol_campaign(
+        g, reps, horizon, protocol="pushpull", chunk_size=128
+    )
+    split = run_protocol_campaign(
+        g, reps, horizon, protocol="pushpull", chunk_size=32, batch_size=2
+    )
+    np.testing.assert_array_equal(whole.received, split.received)
+    np.testing.assert_array_equal(whole.sent, split.sent)
+    np.testing.assert_array_equal(whole.coverage, split.coverage)
+
+
+def test_protocol_campaign_checkpoint_resume_equivalence(tmp_path):
+    """An interrupted campaign resumes from its batch-boundary snapshot
+    to exactly the uninterrupted result; a fingerprint mismatch (other
+    protocol) starts fresh and still lands the right numbers."""
+    g = pg.erdos_renyi(64, 0.1, seed=3)
+    horizon, s = 20, 2
+    reps = flood_replicas(g, s, list(range(7)), horizon)
+    loss = LinkLossModel(0.15, seed=5)
+    ck = str(tmp_path / "camp.npz")
+    whole = run_protocol_campaign(
+        g, reps, horizon, protocol="pushpull", loss=loss, batch_size=3
+    )
+    run_protocol_campaign(
+        g, reps, horizon, protocol="pushpull", loss=loss, batch_size=3,
+        checkpoint_path=ck, stop_after_batches=1,
+    )
+    resumed = run_protocol_campaign(
+        g, reps, horizon, protocol="pushpull", loss=loss, batch_size=3,
+        checkpoint_path=ck,
+    )
+    np.testing.assert_array_equal(whole.received, resumed.received)
+    np.testing.assert_array_equal(whole.sent, resumed.sent)
+    np.testing.assert_array_equal(whole.coverage, resumed.coverage)
+    # Mismatched fingerprint (different protocol) must NOT resume.
+    other = run_protocol_campaign(
+        g, reps, horizon, protocol="pull", loss=loss, batch_size=3,
+        checkpoint_path=ck,
+    )
+    ref = run_protocol_campaign(
+        g, reps, horizon, protocol="pull", loss=loss, batch_size=3
+    )
+    np.testing.assert_array_equal(other.received, ref.received)
+
+
+def test_coverage_campaign_checkpoint_resume_equivalence(tmp_path):
+    """The flood campaign checkpoints the same way (coverage rows are
+    whole at batch boundaries, so they snapshot too)."""
+    g = pg.erdos_renyi(64, 0.1, seed=4)
+    horizon, s = 20, 2
+    reps = flood_replicas(g, s, list(range(7)), horizon)
+    ck = str(tmp_path / "cov.npz")
+    whole = run_coverage_campaign(g, reps, horizon, chunk_size=64,
+                                  batch_size=3)
+    run_coverage_campaign(
+        g, reps, horizon, chunk_size=64, batch_size=3,
+        checkpoint_path=ck, stop_after_batches=2,
+    )
+    resumed = run_coverage_campaign(
+        g, reps, horizon, chunk_size=64, batch_size=3, checkpoint_path=ck
+    )
+    np.testing.assert_array_equal(whole.received, resumed.received)
+    np.testing.assert_array_equal(whole.sent, resumed.sent)
+    np.testing.assert_array_equal(whole.coverage, resumed.coverage)
+
+
+def test_protocol_campaign_mesh_replica_axis():
+    """Replica axis sharded over the device mesh: identical results
+    (conftest provides 8 virtual devices)."""
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+
+    g = pg.erdos_renyi(64, 0.1, seed=5)
+    reps = flood_replicas(g, 2, list(range(5)), 16)
+    plain = run_protocol_campaign(g, reps, 16, protocol="pushpull")
+    sharded = run_protocol_campaign(
+        g, reps, 16, protocol="pushpull", mesh=make_mesh(2, 4)
+    )
+    assert sharded.batch_size == 8  # rounded up to the device count
+    np.testing.assert_array_equal(plain.received, sharded.received)
+    np.testing.assert_array_equal(plain.coverage, sharded.coverage)
+
+
+def test_protocol_campaign_validation():
+    g = pg.erdos_renyi(32, 0.2, seed=6)
+    reps = flood_replicas(g, 2, [0, 1], 8)
+    with pytest.raises(ValueError, match="pushpull|pull|pushk"):
+        run_protocol_campaign(g, reps, 8, protocol="push")
+    with pytest.raises(ValueError, match="fanout"):
+        run_protocol_campaign(g, reps, 8, protocol="pushk", fanout=0)
+    from unittest import mock
+
+    from p2p_gossip_tpu.engine.sync import DeviceGraph
+    from p2p_gossip_tpu.models.protocols import PullCreditBoundError
+
+    # Prebuild the staging BEFORE mocking max_degree: DeviceGraph.build
+    # sizes the ELL from it, and a 2^27-wide mock would allocate it.
+    dg = DeviceGraph.build(g, bucketed=False)
+    with mock.patch.object(
+        type(g), "max_degree", property(lambda self: 1 << 27)
+    ):
+        with pytest.raises(PullCreditBoundError):
+            run_protocol_campaign(g, reps, 8, protocol="pull",
+                                  chunk_size=128, device_graph=dg)
+
+
+# ---------------------------------------------------------------- sweep ----
+
+
+def test_sweep_protocol_cells_all_ride_vmap():
+    """Sweep-record hygiene: after this PR no pushpull/pull/pushk cell may
+    emit engine "sequential", and record schemas are identical across
+    engines (same keys at the top level and in the summary)."""
+    from p2p_gossip_tpu.batch.sweep import run_sweep
+
+    spec = {
+        "numNodes": 48,
+        "p": 0.15,
+        "protocol": ["push", "pushpull", "pull", "pushk"],
+        "fanout": [2],
+        "replicas": 3,
+        "shares": 2,
+        "horizon": 16,
+    }
+    records = run_sweep(spec)
+    assert len(records) == 4
+    for rec in records:
+        assert rec["engine"] == "vmap", rec["cell"]["protocol"]
+        json.dumps(rec)  # strict JSON
+    keysets = {tuple(sorted(r)) for r in records}
+    assert len(keysets) == 1
+    summary_keys = {tuple(sorted(r["summary"])) for r in records}
+    assert len(summary_keys) == 1
+
+
+def test_sweep_vmap_cell_equals_sequential_reference():
+    """The vmapped protocol cell is bitwise the pre-vmap sequential
+    engine's cell — same counters, coverage, and ensemble summary."""
+    from p2p_gossip_tpu.batch.stats import ensemble_summary
+    from p2p_gossip_tpu.batch.sweep import (
+        _build_graph,
+        _cell_loss,
+        _cell_seeds,
+        _run_partnered_cell,
+        expand_grid,
+        run_cell,
+    )
+
+    cell = expand_grid(
+        {
+            "numNodes": 48,
+            "p": 0.15,
+            "protocol": "pushk",
+            "fanout": 2,
+            "lossProb": 0.2,
+            "replicas": 3,
+            "shares": 2,
+            "horizon": 16,
+        }
+    )[0]
+    record, result = run_cell(cell)
+    assert record["engine"] == "vmap"
+    graph = _build_graph(cell)
+    seq = _run_partnered_cell(cell, graph, _cell_seeds(cell),
+                              _cell_loss(cell))
+    np.testing.assert_array_equal(result.received, seq.received)
+    np.testing.assert_array_equal(result.sent, seq.sent)
+    np.testing.assert_array_equal(result.coverage, seq.coverage)
+    want = ensemble_summary(seq, cell["coverageFraction"])
+    got = dict(record["summary"])
+    # Wall-clock fields differ by construction; everything else must not.
+    for k in ("wall_s", "batch_size"):
+        want.pop(k), got.pop(k)
+    assert got == want
+
+
+def test_scatter_or_bits_matches_numpy_oracle():
+    """The narrow-row scatter-OR (bit scatter-add) computes the exact OR
+    — checked against ``np.bitwise_or.at``, mask included. (The sort +
+    segmented-scan construction is covered by test_ops.py and by every
+    solo-parity suite; comparing the jnp paths directly would just pay
+    two eager-compile bills for the same ground truth.)"""
+    import jax.numpy as jnp
+
+    from p2p_gossip_tpu.ops.segment import (
+        SCATTER_OR_BITS_MAX_WORDS,
+        scatter_or_auto,
+        scatter_or_bits,
+    )
+
+    rng = np.random.default_rng(0)
+    for w in (1, 2, 5):
+        m, n = 257, 64
+        dst = rng.integers(0, n, m, dtype=np.int32)
+        pay = rng.integers(0, 2**32, (m, w), dtype=np.uint32)
+        mask = rng.random(m) < 0.7
+        want = np.zeros((n, w), dtype=np.uint32)
+        np.bitwise_or.at(want, dst, pay)
+        got = scatter_or_bits(n, jnp.asarray(dst), jnp.asarray(pay))
+        np.testing.assert_array_equal(np.asarray(got), want)
+        want_m = np.zeros((n, w), dtype=np.uint32)
+        np.bitwise_or.at(want_m, dst[mask], pay[mask])
+        got_m = scatter_or_bits(
+            n, jnp.asarray(dst), jnp.asarray(pay), jnp.asarray(mask)
+        )
+        np.testing.assert_array_equal(np.asarray(got_m), want_m)
+        if w <= SCATTER_OR_BITS_MAX_WORDS:
+            # auto dispatches narrow rows to the bits path.
+            auto = scatter_or_auto(n, jnp.asarray(dst), jnp.asarray(pay))
+            np.testing.assert_array_equal(np.asarray(auto), want)
