@@ -334,6 +334,35 @@ def main() -> None:
         f"{pp_seq_warm / pcamp_warm:.1f}x warm ({camp_label})"
     )
 
+    # Static-analysis audit status rides every bench record so the
+    # driver sees per round whether the compiled surfaces passed the
+    # invariant gate (scripts/staticcheck.py). The audit itself is
+    # platform-independent — it runs on host CPU in a subprocess so a
+    # wedged tunnel can't hang it; the battery's dedicated staticcheck
+    # stage covers the on-chip --compile leg. Smoke runs take the
+    # lint-only fast path (no jax import) to keep harness tests quick.
+    import subprocess
+
+    sc_args = [sys.executable, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts",
+        "staticcheck.py"), "--json"]
+    if smoke:
+        sc_args.append("--lint-only")
+    try:
+        sc_env = dict(os.environ)
+        sc_env["JAX_PLATFORMS"] = "cpu"
+        sc = subprocess.run(
+            sc_args, capture_output=True, text=True, timeout=600,
+            env=sc_env,
+        )
+        staticcheck_ok = sc.returncode == 0
+        if not staticcheck_ok:
+            log(f"staticcheck: FAIL (rc={sc.returncode}) "
+                f"{sc.stdout[-400:]}")
+    except Exception as e:  # timeout or spawn failure: unknown, not ok
+        log(f"staticcheck: did not complete ({type(e).__name__})")
+        staticcheck_ok = None
+
     row = {
         "metric": (
             f"node-updates/sec ({n // 1000}K-node p={p:g} gossip "
@@ -362,6 +391,9 @@ def main() -> None:
         # one clock (profile_capture.py) instead of via bandwidth ratios
         # whose denominators differ (device busy time vs bench wall).
         "modeled_bytes_total": round(bytes_tick * ticks),
+        # True/False from the host-CPU audit subprocess; None when the
+        # audit itself could not run (never silently green).
+        "staticcheck_ok": staticcheck_ok,
     }
     row["campaign"] = {
         "metric": (

@@ -67,8 +67,10 @@ from p2p_gossip_tpu.engine.sync import (
 )
 from p2p_gossip_tpu.models.churn import ChurnModel, effective_generated, random_churn
 from p2p_gossip_tpu.models.generation import Schedule, uniform_renewal_schedule
+from p2p_gossip_tpu.models.seeds import churn_stream_seed
 from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
+from p2p_gossip_tpu.staticcheck.registry import audited
 from p2p_gossip_tpu.utils import logging as p2plog
 from p2p_gossip_tpu.utils.stats import NodeStats
 
@@ -126,15 +128,16 @@ def _stack_churn(
     n: int, horizon: int, seeds, churn_prob: float,
     mean_down_ticks: float, max_outages: int,
 ) -> tuple[np.ndarray, np.ndarray] | None:
-    """Per-replica churn intervals, sampled with the CLI's seed offset
-    (+7919) so replica seeds reproduce solo ``--churnProb`` runs."""
+    """Per-replica churn intervals, sampled with the CLI's churn stream
+    offset (models/seeds.py) so replica seeds reproduce solo
+    ``--churnProb`` runs."""
     if churn_prob <= 0.0:
         return None
     models = [
         random_churn(
             n, horizon, outage_prob=churn_prob,
             mean_down_ticks=mean_down_ticks, max_outages=max_outages,
-            seed=int(s) + 7919,
+            seed=churn_stream_seed(s),
         )
         for s in seeds
     ]
@@ -331,6 +334,11 @@ def _batched_tick(dg, block, t, seen, hist, received, sent,
     return jax.vmap(fn)(*args)
 
 
+@audited(
+    "batch.campaign._run_coverage_batch",
+    spec=lambda: _audit_spec_batch("coverage"),
+    count_compiles=True,
+)
 @functools.partial(
     jax.jit,
     static_argnames=("chunk_size", "horizon", "block", "loss", "coverage_slots"),
@@ -404,6 +412,11 @@ def _run_coverage_batch(
     return seen, received, sent, coverage
 
 
+@audited(
+    "batch.campaign._run_while_batch",
+    spec=lambda: _audit_spec_batch("while"),
+    count_compiles=True,
+)
 @functools.partial(
     jax.jit, static_argnames=("chunk_size", "horizon", "block", "loss")
 )
@@ -803,6 +816,41 @@ def run_gossip_campaign(
         wall_s=wall,
         batch_size=batch_size,
         coverage=None,
+    )
+
+
+# --- staticcheck audit specs (p2p_gossip_tpu/staticcheck/) ----------------
+
+def _audit_spec_batch(kind: str):
+    """Tiny replica batch for the jaxpr auditor: B=2 replicas x 48 nodes,
+    one 32-share chunk — same operand structure the campaign drivers
+    stage, loss seeds riding the batch axis so the traced-seed path is
+    the audited one."""
+    from p2p_gossip_tpu.engine.sync import _audit_inputs
+    from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+
+    chunk, horizon, b = 32, 16, 2
+    dg, origins, gen_ticks = _audit_inputs(chunk, horizon)
+    origins_b = jnp.broadcast_to(origins, (b, chunk))
+    gen_ticks_b = jnp.broadcast_to(gen_ticks, (b, chunk))
+    lseeds_b = jnp.arange(b, dtype=jnp.uint32)
+    common = dict(chunk_size=chunk, horizon=horizon, block=8, loss=(1 << 20, None))
+    if kind == "coverage":
+        return AuditSpec(
+            args=(dg, origins_b, gen_ticks_b, None, lseeds_b),
+            kwargs=dict(**common, coverage_slots=4),
+            integer_only=True,
+            bitmask_words=bitmask.num_words(chunk),
+        )
+    return AuditSpec(
+        args=(
+            dg, origins_b, gen_ticks_b,
+            jnp.asarray(0, dtype=jnp.int32), jnp.asarray(2, dtype=jnp.int32),
+            None, lseeds_b,
+        ),
+        kwargs=common,
+        integer_only=True,
+        bitmask_words=bitmask.num_words(chunk),
     )
 
 

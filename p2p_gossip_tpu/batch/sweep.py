@@ -48,6 +48,7 @@ from p2p_gossip_tpu.batch.campaign import (
 from p2p_gossip_tpu.models import topology as topo
 from p2p_gossip_tpu.models.generation import Schedule
 from p2p_gossip_tpu.models.linkloss import LinkLossModel
+from p2p_gossip_tpu.models.seeds import churn_stream_seed, loss_stream_seed
 from p2p_gossip_tpu.utils import logging as p2plog
 
 log = p2plog.get_logger("Batch.Sweep")
@@ -149,8 +150,10 @@ def _build_graph(cell: dict):
 def _cell_loss(cell: dict) -> LinkLossModel | None:
     if cell["lossProb"] <= 0.0:
         return None
-    # Same offset as the CLI so cell results reproduce solo runs.
-    return LinkLossModel(cell["lossProb"], seed=int(cell["baseSeed"]) + 104729)
+    # Same stream derivation as the CLI so cell results reproduce solo runs.
+    return LinkLossModel(
+        cell["lossProb"], seed=loss_stream_seed(cell["baseSeed"])
+    )
 
 
 def _run_partnered_cell(cell, graph, seeds, loss) -> CampaignResult:
@@ -176,7 +179,8 @@ def _run_partnered_cell(cell, graph, seeds, loss) -> CampaignResult:
             random_churn(
                 graph.n, horizon, outage_prob=cell["churnProb"],
                 mean_down_ticks=cell["churnDowntimeTicks"],
-                max_outages=cell["churnOutages"], seed=int(seed) + 7919,
+                max_outages=cell["churnOutages"],
+                seed=churn_stream_seed(seed),
             )
             if cell["churnProb"] > 0.0
             else None

@@ -50,6 +50,7 @@ from p2p_gossip_tpu.ops.ell import (
     propagate_uniform,
     tuned_degree_block,
 )
+from p2p_gossip_tpu.staticcheck.registry import audited
 from p2p_gossip_tpu.utils import logging as p2plog
 from p2p_gossip_tpu.utils.stats import NodeStats
 
@@ -426,6 +427,7 @@ def _tick_body(
     return (t + 1, seen, hist, received, sent)
 
 
+@audited("engine.sync._run_chunk_while", spec=lambda: _audit_spec_chunk_while())
 @functools.partial(
     jax.jit,
     static_argnames=("chunk_size", "horizon", "block", "loss", "connect_tick"),
@@ -492,6 +494,10 @@ def _run_chunk_while(
     return seen, received, sent, snaps, t - t_start
 
 
+@audited(
+    "engine.sync._run_chunk_coverage",
+    spec=lambda: _audit_spec_chunk_coverage(),
+)
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -802,6 +808,56 @@ def run_flood_coverage(
     coverage = np.asarray(cov)[:, :s]
     stats.extra["coverage"] = coverage
     return stats, coverage
+
+
+# --- staticcheck audit specs (p2p_gossip_tpu/staticcheck/) ----------------
+# Tiny-shape operand builders for the registered kernels above. Evaluated
+# lazily at audit time only (the @audited decorator stores a thunk), so
+# they cost nothing at import and may use anything defined in this module.
+
+def _audit_inputs(chunk: int = 32, horizon: int = 16):
+    from p2p_gossip_tpu.models.topology import erdos_renyi
+
+    graph = erdos_renyi(48, 0.2, seed=0)
+    dg = DeviceGraph.build(graph)
+    sched = Schedule(
+        graph.n,
+        np.arange(4, dtype=np.int32) * 7 % graph.n,
+        np.arange(4, dtype=np.int32) % 3,
+    )
+    origins, gen_ticks = sched.padded(chunk, horizon)
+    return dg, jnp.asarray(origins), jnp.asarray(gen_ticks)
+
+
+def _audit_spec_chunk_while():
+    from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+
+    chunk, horizon = 32, 16
+    dg, origins, gen_ticks = _audit_inputs(chunk, horizon)
+    return AuditSpec(
+        args=(
+            dg, origins, gen_ticks,
+            jnp.asarray(0, dtype=jnp.int32), jnp.asarray(2, dtype=jnp.int32),
+        ),
+        kwargs=dict(chunk_size=chunk, horizon=horizon, block=8),
+        integer_only=True,
+        bitmask_words=bitmask.num_words(chunk),
+    )
+
+
+def _audit_spec_chunk_coverage():
+    from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+
+    chunk, horizon = 32, 16
+    dg, origins, gen_ticks = _audit_inputs(chunk, horizon)
+    return AuditSpec(
+        args=(dg, origins, gen_ticks),
+        kwargs=dict(
+            chunk_size=chunk, horizon=horizon, block=8, coverage_slots=4,
+        ),
+        integer_only=True,
+        bitmask_words=bitmask.num_words(chunk),
+    )
 
 
 def time_to_coverage(coverage: np.ndarray, n: int, fraction: float = 0.99):

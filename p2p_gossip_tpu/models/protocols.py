@@ -41,6 +41,7 @@ from p2p_gossip_tpu.models.partnersel import pick_index_jnp
 from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
 from p2p_gossip_tpu.ops.segment import scatter_or_auto
+from p2p_gossip_tpu.staticcheck.registry import audited
 from p2p_gossip_tpu.utils.stats import NodeStats
 
 
@@ -183,6 +184,10 @@ def _pushpull_scan(
     return seen, received, (sent_lo, sent_hi), coverage
 
 
+@audited(
+    "models.protocols._run_pushpull",
+    spec=lambda: _audit_spec_solo("pushpull"),
+)
 @functools.partial(
     jax.jit,
     static_argnames=("chunk_size", "horizon", "record_coverage", "loss", "mode"),
@@ -211,6 +216,11 @@ def _run_pushpull(
     )
 
 
+@audited(
+    "models.protocols._run_pushpull_replicas",
+    spec=lambda: _audit_spec_replicas("pushpull"),
+    count_compiles=True,
+)
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -670,6 +680,9 @@ def _pushk_scan(
     return seen, received, (sent_lo, sent_hi), coverage
 
 
+@audited(
+    "models.protocols._run_pushk", spec=lambda: _audit_spec_solo("pushk")
+)
 @functools.partial(
     jax.jit,
     static_argnames=("fanout", "chunk_size", "horizon", "record_coverage", "loss"),
@@ -696,6 +709,11 @@ def _run_pushk(
     )
 
 
+@audited(
+    "models.protocols._run_pushk_replicas",
+    spec=lambda: _audit_spec_replicas("pushk"),
+    count_compiles=True,
+)
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -785,6 +803,73 @@ def run_pushk_sim(
         graph, schedule, horizon_ticks, ell_delays, constant_delay, seed,
         record_coverage, partners_override, device_graph, chunk_size, churn,
         loss, checkpoint_path, checkpoint_every, stop_after_chunks,
+    )
+
+
+# --- staticcheck audit specs (p2p_gossip_tpu/staticcheck/) ----------------
+
+def _audit_inputs_partnered(chunk: int = 32, horizon: int = 8):
+    """Tiny full-width (bucketed=False) graph + one share chunk — the
+    operand structure every partnered kernel takes."""
+    from p2p_gossip_tpu.models.topology import erdos_renyi
+
+    graph = erdos_renyi(48, 0.2, seed=0)
+    dg = DeviceGraph.build(graph, bucketed=False)
+    sched = Schedule(
+        graph.n,
+        np.arange(4, dtype=np.int32) * 5 % graph.n,
+        np.zeros(4, dtype=np.int32),
+    )
+    origins, gen_ticks = sched.padded(chunk, horizon)
+    return dg, jnp.asarray(origins), jnp.asarray(gen_ticks)
+
+
+def _audit_spec_solo(protocol: str):
+    from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+
+    chunk, horizon = 32, 8
+    dg, origins, gen_ticks = _audit_inputs_partnered(chunk, horizon)
+    override = jnp.zeros((0,), dtype=jnp.int32)
+    kwargs = dict(
+        chunk_size=chunk, horizon=horizon, record_coverage=True,
+        loss=(1 << 20, 7),
+    )
+    if protocol == "pushk":
+        kwargs["fanout"] = 2
+    else:
+        kwargs["mode"] = protocol
+    return AuditSpec(
+        args=(dg, origins, gen_ticks, jnp.uint32(42), override),
+        kwargs=kwargs,
+        integer_only=True,
+        bitmask_words=bitmask.num_words(chunk),
+    )
+
+
+def _audit_spec_replicas(protocol: str):
+    from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+
+    chunk, horizon, b = 32, 8, 2
+    dg, origins, gen_ticks = _audit_inputs_partnered(chunk, horizon)
+    origins_b = jnp.broadcast_to(origins, (b, chunk))
+    gen_ticks_b = jnp.broadcast_to(gen_ticks, (b, chunk))
+    seeds_b = jnp.arange(b, dtype=jnp.uint32)
+    lseeds_b = jnp.arange(b, dtype=jnp.uint32) + 11
+    kwargs = dict(
+        chunk_size=chunk, horizon=horizon, record_coverage=True,
+        loss_threshold=1 << 20,
+    )
+    if protocol == "pushk":
+        kwargs["fanout"] = 2
+    else:
+        kwargs["mode"] = protocol
+    return AuditSpec(
+        args=(dg, origins_b, gen_ticks_b, seeds_b, lseeds_b),
+        kwargs=kwargs,
+        integer_only=True,
+        # The u64 ``sent`` counter halves come back as (B, N) uint32 —
+        # the node axis is a legal uint32 minor dim alongside the words.
+        bitmask_words=(bitmask.num_words(chunk), dg.n),
     )
 
 

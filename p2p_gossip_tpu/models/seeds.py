@@ -1,0 +1,46 @@
+"""Canonical seed-stream derivation — the ONE home of the offsets.
+
+Every stochastic model derives its stream from the run seed with a fixed
+prime offset, so one ``--seed`` reproduces every coin in a run while the
+streams stay decorrelated from each other:
+
+- link-loss erasure coins:   ``seed + LOSS_SEED_OFFSET``  (104729)
+- churn downtime sampling:   ``seed + CHURN_SEED_OFFSET`` (7919)
+- replica r of a campaign:   replica seed ``seed + r``, each replica's
+  loss stream then ``loss_stream_seed(seed + r)`` — the
+  ``seed + r + 104729`` contract the CLI's ``--replicas`` documents, and
+  what makes a campaign replica bitwise-reproducible by a solo run with
+  the same derived seeds.
+
+These constants used to be hardcoded at every call site; the staticcheck
+AST lint (rule ``seed-offset-literal``, docs/STATIC_ANALYSIS.md) now
+rejects the literals anywhere outside this module, because a shadowed
+copy drifts silently when the contract changes — and two call sites
+disagreeing on the offset makes replica streams collide with solo runs
+instead of reproducing them.
+"""
+
+from __future__ import annotations
+
+#: Offset of the link-loss erasure stream from the run seed.
+LOSS_SEED_OFFSET = 104729
+
+#: Offset of the churn downtime-sampling stream from the run seed.
+CHURN_SEED_OFFSET = 7919
+
+
+def loss_stream_seed(seed) -> int:
+    """The link-loss stream seed a run (or one campaign replica, passing
+    its own ``seed + r``) derives from its seed."""
+    return int(seed) + LOSS_SEED_OFFSET
+
+
+def churn_stream_seed(seed) -> int:
+    """The churn-sampling stream seed derived from a run/replica seed."""
+    return int(seed) + CHURN_SEED_OFFSET
+
+
+def replica_loss_seeds(seeds) -> list[int]:
+    """Per-replica loss stream seeds for a campaign's replica seed list —
+    the ``seed + r + 104729`` contract, given ``seeds = [base + r, ...]``."""
+    return [loss_stream_seed(s) for s in seeds]

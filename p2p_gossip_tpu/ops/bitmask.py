@@ -12,6 +12,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from p2p_gossip_tpu.staticcheck.registry import audited
+
 WORD_BITS = 32
 
 
@@ -30,6 +32,7 @@ def popcount_rows(words: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+@audited("ops.bitmask.slot_scatter", spec=lambda: _audit_spec("scatter"))
 def slot_scatter(
     n_nodes: int,
     n_words: int,
@@ -74,6 +77,9 @@ def combine_u64(lo: jnp.ndarray, hi: jnp.ndarray):
     )
 
 
+@audited(
+    "ops.bitmask.coverage_per_slot_scan", spec=lambda: _audit_spec("cov_scan")
+)
 def coverage_per_slot_scan(seen: jnp.ndarray, n_slots: int) -> jnp.ndarray:
     """``coverage_per_slot`` with the 32 per-bit reductions rolled into a
     ``lax.scan`` — bitwise-identical counts (integer sums in the same
@@ -97,6 +103,7 @@ def coverage_per_slot_scan(seen: jnp.ndarray, n_slots: int) -> jnp.ndarray:
     return counts.T.reshape(n_words * WORD_BITS)[:n_slots]
 
 
+@audited("ops.bitmask.coverage_per_slot", spec=lambda: _audit_spec("cov"))
 def coverage_per_slot(seen: jnp.ndarray, n_slots: int) -> jnp.ndarray:
     """Per-share coverage: (N, W) seen-bitmask -> (S,) int32 node counts.
 
@@ -118,3 +125,40 @@ def coverage_per_slot(seen: jnp.ndarray, n_slots: int) -> jnp.ndarray:
         axis=1,
     )  # (W, 32): slot s = word s//32, bit s%32
     return counts.reshape(n_words * WORD_BITS)[:n_slots]
+
+
+# --- staticcheck audit specs (p2p_gossip_tpu/staticcheck/) ----------------
+
+def _audit_spec(kind: str):
+    """Tiny bitmask operands for the jaxpr auditor: N=8 rows, W=2 words."""
+    import numpy as np
+
+    from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+
+    n, w = 8, 2
+    rng = np.random.default_rng(0)
+    if kind in ("cov", "cov_scan"):
+        seen = jnp.asarray(
+            rng.integers(0, 1 << 32, (n, w), dtype=np.uint64),
+            dtype=jnp.uint32,
+        )
+        # Static slot count baked into the wrapper: these are plain
+        # functions, so a positional int would otherwise be traced.
+        cov_fn = coverage_per_slot if kind == "cov" else coverage_per_slot_scan
+        return AuditSpec(
+            fn=lambda s_arr: cov_fn(s_arr, w * WORD_BITS - 3),
+            args=(seen,),
+            integer_only=True,
+            bitmask_words=w,
+        )
+    s = w * WORD_BITS
+    return AuditSpec(
+        fn=lambda rows, slots, active: slot_scatter(n, w, rows, slots, active),
+        args=(
+            jnp.asarray(rng.integers(0, n, s), dtype=jnp.int32),
+            jnp.arange(s, dtype=jnp.int32),
+            jnp.asarray(rng.random(s) < 0.5),
+        ),
+        integer_only=True,
+        bitmask_words=w,
+    )

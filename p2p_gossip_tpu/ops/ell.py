@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from p2p_gossip_tpu.staticcheck.registry import audited
+
 DEFAULT_DEGREE_BLOCK = 8
 
 # Swept on a real v5e chip (engine-level, 100K-node p=0.001 ER graph,
@@ -84,6 +86,7 @@ def _loss_keep(b_idx, dst_ids, tick, loss, loss_seed=None):
     return ~drop_mask_jnp(b_idx, dst_ids[:, None], tick, threshold, seed)
 
 
+@audited("ops.ell.propagate", spec=lambda: _audit_spec_propagate("per_edge"))
 @functools.partial(jax.jit, static_argnames=("ring_size", "block", "loss"))
 def propagate(
     hist: jnp.ndarray,      # (D, N, W) uint32 — newly-frontier history ring
@@ -146,6 +149,10 @@ def propagate(
     return arrivals
 
 
+@audited(
+    "ops.ell.gather_or_frontier",
+    spec=lambda: _audit_spec_propagate("frontier"),
+)
 @functools.partial(jax.jit, static_argnames=("block", "loss"))
 def gather_or_frontier(
     frontier: jnp.ndarray,  # (N_src, W) uint32 — ONE delay slice of history
@@ -193,6 +200,10 @@ def gather_or_frontier(
     return arrivals
 
 
+@audited(
+    "ops.ell.propagate_uniform",
+    spec=lambda: _audit_spec_propagate("uniform"),
+)
 @functools.partial(
     jax.jit, static_argnames=("ring_size", "block", "uniform_delay", "loss")
 )
@@ -512,6 +523,52 @@ def propagate_bucketed(
     order = jnp.concatenate([b[0] for b in buckets])
     arrivals = jnp.zeros((n_out, w), dtype=jnp.uint32)
     return arrivals.at[order].set(jnp.concatenate(parts), mode="drop")
+
+
+# --- staticcheck audit specs (p2p_gossip_tpu/staticcheck/) ----------------
+
+def _audit_spec_propagate(kind: str):
+    """Tiny ELL gather for the jaxpr auditor: 8 rows, degree cap 3, W=2
+    words, with the loss coin on and a traced loss seed (the campaign
+    path) so the erasure hash is part of the audited graph."""
+    import numpy as np
+
+    from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+
+    rng = np.random.default_rng(0)
+    n, dmax, w, ring = 8, 3, 2, 2
+    hist = jnp.zeros((ring, n, w), dtype=jnp.uint32)
+    idx = jnp.asarray(rng.integers(0, n, (n, dmax)), dtype=jnp.int32)
+    msk = jnp.asarray(rng.random((n, dmax)) < 0.8)
+    tick = jnp.asarray(1, dtype=jnp.int32)
+    lseed = jnp.uint32(3)
+    common = dict(
+        integer_only=True,
+        bitmask_words=w,
+    )
+    if kind == "frontier":
+        return AuditSpec(
+            args=(hist[0], tick, idx, msk),
+            kwargs=dict(block=2, loss=(1 << 20, None), loss_seed=lseed),
+            **common,
+        )
+    if kind == "uniform":
+        return AuditSpec(
+            args=(hist, tick, idx, msk),
+            kwargs=dict(
+                ring_size=ring, uniform_delay=1, block=2,
+                loss=(1 << 20, None), loss_seed=lseed,
+            ),
+            **common,
+        )
+    dly = jnp.asarray(rng.integers(1, ring, (n, dmax)), dtype=jnp.int32)
+    return AuditSpec(
+        args=(hist, tick, idx, dly, msk),
+        kwargs=dict(
+            ring_size=ring, block=2, loss=(1 << 20, None), loss_seed=lseed,
+        ),
+        **common,
+    )
 
 
 def propagate_reference(hist, tick, ell_idx, ell_delay, ell_mask, *, ring_size):

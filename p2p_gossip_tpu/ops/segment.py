@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from p2p_gossip_tpu.staticcheck.registry import audited
+
 #: Row widths (uint32 words) at or below which the bit-unpack scatter-add
 #: beats the sort + segmented scan. Swept on CPU at B=32 x M=1024:
 #: bits wins 2x at W=1-2, ties at W=4, loses 2.4x at W=8 — the unpack's
@@ -35,6 +37,7 @@ from jax import lax
 SCATTER_OR_BITS_MAX_WORDS = 2
 
 
+@audited("ops.segment.scatter_or", spec=lambda: _audit_spec_scatter(False))
 def scatter_or(
     n_rows: int,
     dst: jnp.ndarray,     # (M,) int32 destination row per payload
@@ -77,6 +80,7 @@ def scatter_or(
     return out[:n_rows]
 
 
+@audited("ops.segment.scatter_or_bits", spec=lambda: _audit_spec_scatter(True))
 def scatter_or_bits(
     n_rows: int,
     dst: jnp.ndarray,     # (M,) int32 destination row per payload
@@ -98,6 +102,34 @@ def scatter_or_bits(
         (acc > 0).astype(jnp.uint32) << shifts, axis=2, dtype=jnp.uint32
     )
     return words[:n_rows]
+
+
+# --- staticcheck audit spec (p2p_gossip_tpu/staticcheck/) -----------------
+
+def _audit_spec_scatter(bits: bool):
+    """Tiny scatter-OR for the jaxpr auditor. The bit variant legitimately
+    carries (M, W, 32) uint32 intermediates — its 32 unpacked lanes —
+    so its allowed word set includes the lane axis."""
+    import numpy as np
+
+    from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+
+    m, w, n_rows = 6, 2, 8
+    rng = np.random.default_rng(0)
+    impl = scatter_or_bits if bits else scatter_or
+    return AuditSpec(
+        # Static row count baked into the wrapper (plain function — a
+        # positional int would otherwise be traced).
+        fn=lambda dst, payload, mask: impl(n_rows, dst, payload, mask),
+        args=(
+            jnp.asarray(rng.integers(0, n_rows, m), dtype=jnp.int32),
+            jnp.asarray(rng.integers(0, 1 << 32, (m, w), dtype=np.uint64),
+                        dtype=jnp.uint32),
+            jnp.asarray(rng.random(m) < 0.8),
+        ),
+        integer_only=True,
+        bitmask_words=w,
+    )
 
 
 def scatter_or_auto(

@@ -606,6 +606,65 @@ def build_sharded_runner(
     return jax.jit(mapped), n_share_shards * chunk_size
 
 
+# --- staticcheck audit spec (p2p_gossip_tpu/staticcheck/) -----------------
+
+def _audit_mesh():
+    """Smallest real mesh the audit can stage on this host: 2x2 when at
+    least four devices exist (tests force 8 virtual CPU devices), else
+    1x1 — a single TPU chip still traces the full shard_map program."""
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    shards = 2 if len(devices) >= 4 else 1
+    return make_mesh(shards, shards, devices=devices[: shards * shards]), shards
+
+
+def _audit_spec_flood_runner():
+    """Stage + compile-build the sharded flood runner on tiny shapes and
+    hand the auditor the exact mapped callable the production driver
+    runs (shard_map + jit), uniform delay, sharded ring."""
+    from p2p_gossip_tpu.models.topology import erdos_renyi
+    from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+
+    mesh, _ = _audit_mesh()
+    graph = erdos_renyi(16, 0.3, seed=0)
+    chunk, horizon = 32, 16
+    (ell_idx, ell_delay, ell_mask, degree, ring, uniform, n_padded, block,
+     churn_start, churn_end) = _stage_sharded_inputs(
+        graph, None, 1, mesh, None, None
+    )
+    (ring_mode, ell_args, delay_values, bucket_counts,
+     _extra) = _resolve_and_stage_ring(
+        "auto", uniform, ring, n_padded, mesh.shape[NODES_AXIS],
+        bitmask.num_words(chunk), ell_idx, ell_delay, ell_mask, block=block,
+    )
+    runner, pass_size = build_sharded_runner(
+        mesh, n_padded, ring, chunk, horizon, block, uniform, 0, None,
+        ring_mode=ring_mode, delay_values=delay_values,
+        bucket_counts=bucket_counts,
+    )
+    origins = np.zeros(pass_size, dtype=np.int32)
+    gen_ticks = np.full(pass_size, horizon, dtype=np.int32)
+    gen_ticks[:2] = 0
+    return AuditSpec(
+        fn=runner,
+        args=(
+            ell_args, degree, churn_start, churn_end, origins, gen_ticks,
+            np.int32(0), np.int32(0), np.zeros((0,), dtype=np.int32),
+        ),
+        integer_only=True,
+        bitmask_words=bitmask.num_words(chunk),
+    )
+
+
+from p2p_gossip_tpu.staticcheck.registry import register_entry  # noqa: E402
+
+register_entry(
+    "parallel.engine_sharded.flood_runner",
+    spec=_audit_spec_flood_runner,
+)
+
+
 def run_sharded_sim(
     graph: Graph,
     schedule: Schedule,

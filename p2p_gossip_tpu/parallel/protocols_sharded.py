@@ -313,6 +313,59 @@ def build_partnered_runner(
     return jax.jit(mapped), n_share_shards * chunk_size
 
 
+# --- staticcheck audit spec (p2p_gossip_tpu/staticcheck/) -----------------
+
+def _audit_spec_partnered_runner(protocol: str):
+    """Stage + build the sharded partnered runner on tiny shapes (same
+    mesh policy as the flood audit spec). The u64 ``sent`` counter halves
+    come back as (n_share_shards, n_padded) uint32 stacks, so the allowed
+    uint32 minor dims include the padded row count alongside the bitmask
+    word width."""
+    from p2p_gossip_tpu.models.topology import erdos_renyi
+    from p2p_gossip_tpu.parallel.engine_sharded import _audit_mesh
+    from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+
+    mesh, _ = _audit_mesh()
+    n_node_shards = mesh.shape[NODES_AXIS]
+    graph = erdos_renyi(16, 0.3, seed=0)
+    chunk, horizon = 32, 8
+    ell_idx, ell_delays, _, degree, ring, _ = _padded_device_graph(
+        graph, None, 1, n_node_shards,
+        uniform_placeholder=False, with_mask=False,
+    )
+    n_padded = ell_idx.shape[0]
+    churn_start, churn_end = _padded_churn(None, n_padded, n_node_shards)
+    runner, pass_size = build_partnered_runner(
+        mesh, protocol, n_padded, ring, chunk, horizon,
+        2 if protocol == "pushk" else 1,
+        (1 << 20, 7), False, ring_mode="replicated",
+    )
+    origins = np.zeros(pass_size, dtype=np.int32)
+    gen_ticks = np.full(pass_size, horizon, dtype=np.int32)
+    gen_ticks[:2] = 0
+    return AuditSpec(
+        fn=runner,
+        args=(
+            ell_idx, ell_delays, degree, churn_start, churn_end,
+            origins, gen_ticks, np.uint32(42),
+        ),
+        integer_only=True,
+        bitmask_words=(bitmask.num_words(chunk), n_padded),
+    )
+
+
+from p2p_gossip_tpu.staticcheck.registry import register_entry  # noqa: E402
+
+register_entry(
+    "parallel.protocols_sharded.pushpull_runner",
+    spec=lambda: _audit_spec_partnered_runner("pushpull"),
+)
+register_entry(
+    "parallel.protocols_sharded.pushk_runner",
+    spec=lambda: _audit_spec_partnered_runner("pushk"),
+)
+
+
 def run_sharded_partnered_sim(
     graph: Graph,
     schedule: Schedule,

@@ -20,10 +20,23 @@ from p2p_gossip_tpu.models.generation import Schedule
 from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.utils.stats import NodeStats
 
-_LIB_PATHS = [
-    os.path.join(os.path.dirname(__file__), "..", "..", "native", "libgossip_native.so"),
-    os.path.join(os.path.dirname(__file__), "libgossip_native.so"),
-]
+def _lib_paths() -> list[str]:
+    """Candidate .so paths, P2P_NATIVE_LIB first when set — the override
+    scripts/native_asan.sh uses to run the test suite against a
+    sanitizer-instrumented build without touching the production
+    library. Evaluated per lookup so tests can monkeypatch the env."""
+    paths = []
+    override = os.environ.get("P2P_NATIVE_LIB")
+    if override:
+        paths.append(override)
+    paths += [
+        os.path.join(
+            os.path.dirname(__file__), "..", "..", "native",
+            "libgossip_native.so",
+        ),
+        os.path.join(os.path.dirname(__file__), "libgossip_native.so"),
+    ]
+    return paths
 
 _lib = None
 _lib_checked = False
@@ -78,9 +91,10 @@ def load_library():
     if _lib_checked:
         return _lib
     _lib_checked = True
-    if not any(os.path.exists(os.path.abspath(p)) for p in _LIB_PATHS):
+    lib_paths = _lib_paths()
+    if not any(os.path.exists(os.path.abspath(p)) for p in lib_paths):
         _try_autobuild()
-    for path in _LIB_PATHS:
+    for path in lib_paths:
         path = os.path.abspath(path)
         if os.path.exists(path):
             try:

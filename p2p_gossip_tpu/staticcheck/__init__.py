@@ -1,0 +1,41 @@
+"""Compile-time invariant auditing for the TPU engines.
+
+The NS-3 reference gets correctness "for free" from a sequential event
+loop; this rebuild instead leans on fragile compile-time invariants —
+static shapes, int32/uint32 word-width discipline, traced loss seeds,
+one compilation per sweep grid — that nothing used to check until a
+kernel silently recompiled or a replica stream collided. This package is
+the sanitizer pass for the compiled stack:
+
+- ``registry``     — the lightweight decorator/registry every public
+                     entry point self-registers with, so new engines are
+                     audited by default;
+- ``jaxpr_audit``  — abstract-traces each registered entry and rejects
+                     64-bit dtype promotion, float leakage into the
+                     integer kernels, host callbacks, device transfers,
+                     dynamic shapes, and bitmask word-count mismatches
+                     vs ops/bitmask.py's packing contract;
+- ``recompile``    — the recompile sentinel: replays a small sweep grid
+                     under a jit-cache-miss counter and fails when the
+                     measured compile count drifts from the grid's
+                     expected count;
+- ``astlint``      — AST lint for PRNG/seed discipline (key reuse
+                     without split/fold_in, hardcoded replica seed
+                     offsets, numpy calls and tracer branches inside
+                     jitted bodies);
+- ``fixtures``     — seeded regression fixtures each analyzer must keep
+                     flagging (the CLI's --fixture mode).
+
+CLI: ``python scripts/staticcheck.py [--json]`` — wired into tier-1 via
+scripts/ci_tier1.sh and tests/test_staticcheck.py. Rule catalogue and
+suppression policy: docs/STATIC_ANALYSIS.md.
+
+This module stays import-light (no jax) so engine modules can import the
+registry at module import time without cycles or cost.
+"""
+
+from p2p_gossip_tpu.staticcheck.registry import (  # noqa: F401
+    AuditSpec,
+    audited,
+    register_entry,
+)

@@ -1,0 +1,22 @@
+"""Populate the audit registry: import every module that registers.
+
+The registry is filled by import side effects (the ``@audited`` decorator
+and module-bottom ``register_entry`` calls), so the auditor needs the
+registering modules imported first. This module is that one list; a new
+engine module added here — or imported by anything here — is audited by
+default from then on.
+"""
+
+from __future__ import annotations
+
+
+def load_all() -> None:
+    """Import every registering module (idempotent)."""
+    import p2p_gossip_tpu.batch.campaign  # noqa: F401
+    import p2p_gossip_tpu.engine.sync  # noqa: F401
+    import p2p_gossip_tpu.models.protocols  # noqa: F401
+    import p2p_gossip_tpu.ops.bitmask  # noqa: F401
+    import p2p_gossip_tpu.ops.ell  # noqa: F401
+    import p2p_gossip_tpu.ops.segment  # noqa: F401
+    import p2p_gossip_tpu.parallel.engine_sharded  # noqa: F401
+    import p2p_gossip_tpu.parallel.protocols_sharded  # noqa: F401

@@ -1,0 +1,132 @@
+"""Seeded regression fixtures — deliberately-bad inputs each analyzer
+MUST keep flagging.
+
+The analyzers gate tier-1; a refactor that silently blinds one of them
+would leave the gate green while the guardrail is gone. Each fixture
+here reproduces one historical failure mode on tiny shapes; the CLI's
+``--fixture`` mode runs one and exits non-zero iff the analyzer still
+flags it (tests/test_staticcheck.py asserts all three, and that the
+shipped tree stays clean). This file is excluded from the AST lint scan
+(astlint.EXCLUDE_PARTS) — it is bad on purpose.
+
+Fixtures:
+
+  f64        a "kernel" whose integer math weak-promotes through a
+             Python float and lands on float64 under x64 — the dtype
+             drift that changes every uint32 counter-hash coin
+  recompile  one campaign cell run twice with a drifted replica batch
+             size — the shape wobble that burns a sweep's compile
+             budget (and a tunnel window) silently
+  prng       jax.random key consumed by two samplers without split() —
+             correlated streams masquerading as independent replicas
+"""
+
+from __future__ import annotations
+
+FIXTURES = ("f64", "recompile", "prng")
+
+
+def f64_fixture() -> dict:
+    """Trace a bad integer kernel under x64 and audit it: the auditor
+    must report forbid-64bit (and integer-only) violations."""
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+
+    from p2p_gossip_tpu.staticcheck.jaxpr_audit import audit_entry
+    from p2p_gossip_tpu.staticcheck.registry import AuditEntry, AuditSpec
+
+    def bad_tick_update(seen):
+        # The classic weak-type leak: a Python float in bitmask counter
+        # math. Under x64 the promotion lands on float64.
+        scaled = seen.astype(jnp.int64) * 2.0
+        return scaled.sum()
+
+    def spec():
+        return AuditSpec(
+            args=(jnp.zeros((4, 2), dtype=jnp.uint32),),
+            integer_only=True,
+        )
+
+    entry = AuditEntry(
+        name="fixtures.f64_bad_tick_update", fn=bad_tick_update, spec=spec
+    )
+    with enable_x64():
+        violations = audit_entry(entry)
+    return {
+        "fixture": "f64",
+        "ok": not violations,  # must come back False
+        "violations": [v.as_dict() for v in violations],
+    }
+
+
+def recompile_fixture() -> dict:
+    """Run one campaign cell twice with a drifted batch size: the
+    sentinel's cache counter must see two executables where the cell's
+    signature model allows one."""
+    import jax
+
+    from p2p_gossip_tpu.batch.campaign import (
+        _run_coverage_batch,
+        flood_replicas,
+        run_coverage_campaign,
+    )
+    from p2p_gossip_tpu.models.topology import erdos_renyi
+    from p2p_gossip_tpu.staticcheck.recompile import SentinelReport
+
+    graph = erdos_renyi(48, 0.15, seed=0)
+    replicas = flood_replicas(graph, 2, [0, 1, 2, 3], 16)
+    jax.clear_caches()
+    run_coverage_campaign(graph, replicas, 16)
+    # The deliberate shape drift: same cell, replica batch halved — the
+    # (B, ...) leading axis changes and XLA compiles a second program.
+    run_coverage_campaign(graph, replicas, 16, batch_size=2)
+    expected = {"coverage_batch": 1}
+    measured = {"coverage_batch": int(_run_coverage_batch._cache_size())}
+    report = SentinelReport(
+        ok=measured == expected, expected=expected, measured=measured,
+        cells=1,
+    )
+    return {
+        "fixture": "recompile",
+        "ok": report.ok,  # must come back False
+        "violations": [{"rule": "recompile-sentinel", "message": m}
+                       for m in report.violations()],
+        "expected": expected,
+        "measured": measured,
+    }
+
+
+_PRNG_BAD_SOURCE = '''\
+import jax
+
+
+def sample_two_replicas(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.uniform(key, (8,))
+    b = jax.random.normal(key, (8,))  # same key: b correlates with a
+    return a, b
+'''
+
+
+def prng_fixture() -> dict:
+    """Lint a snippet that reuses a PRNG key across two samplers: the
+    AST lint must report prng-key-reuse."""
+    from p2p_gossip_tpu.staticcheck.astlint import lint_source
+
+    violations = lint_source(_PRNG_BAD_SOURCE, "fixtures/prng_bad.py")
+    flagged = [v for v in violations if v.rule == "prng-key-reuse"]
+    return {
+        "fixture": "prng",
+        "ok": not flagged,  # must come back False
+        "violations": [v.as_dict() for v in flagged],
+    }
+
+
+def run_fixture(name: str) -> dict:
+    if name == "f64":
+        return f64_fixture()
+    if name == "recompile":
+        return recompile_fixture()
+    if name == "prng":
+        return prng_fixture()
+    raise ValueError(f"unknown fixture {name!r}; valid: {FIXTURES}")

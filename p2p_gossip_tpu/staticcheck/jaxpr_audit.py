@@ -1,0 +1,251 @@
+"""Jaxpr invariant auditor — abstract-trace every registered entry point.
+
+The compiled stack's correctness rests on invariants the type system
+never sees: jax runs with x64 disabled so a 64-bit dtype in a jaxpr
+means someone flipped the flag (and every uint32 counter-hash coin now
+computes different bits); the tick kernels are pure integer/bitwise
+programs, so any inexact dtype is a weak-type promotion silently
+upcasting counter chains; host callbacks and device transfers inside a
+kernel serialize the while-loop on the host; and the bitmask packing
+contract (slot s at word s // 32 — ops/bitmask.py) fixes the minor axis
+of every uint32 buffer, so a mismatched word count silently maps slots
+into a different share universe.
+
+``jax.make_jaxpr`` traces each registered entry on its AuditSpec's tiny
+operands (no execution, no device work, sub-second per entry) and the
+walker below visits every equation including nested sub-jaxprs (pjit,
+while, scan, cond, shard_map). Rules, catalogued in
+docs/STATIC_ANALYSIS.md:
+
+  J1 forbid-64bit      int64/uint64/float64/complex128 anywhere
+  J2 integer-only      inexact dtypes in entries marked integer_only
+  J3 no-host-callback  debug_callback / pure_callback / io_callback /
+                       debug_print / callback primitives
+  J4 no-device-put     device_put primitives (implicit transfers)
+  J5 static-shapes     every dimension a concrete int
+  J6 bitmask-words     uint32 arrays of rank >= 2 in the entry's own
+                       signature must pack their minor axis to a declared
+                       word width (internal uint32 arrays are exempt —
+                       the counter-hash coins and bit-position math
+                       legitimately carry uint32 at other widths)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import traceback
+
+FORBIDDEN_64BIT = {"int64", "uint64", "float64", "complex128"}
+HOST_CALLBACK_PRIMITIVES = {
+    "debug_callback", "pure_callback", "io_callback", "callback",
+    "debug_print",
+}
+TRANSFER_PRIMITIVES = {"device_put"}
+
+
+@dataclasses.dataclass
+class Violation:
+    entry: str
+    rule: str
+    message: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:  # human report line
+        return f"{self.entry}: [{self.rule}] {self.message}"
+
+
+def _jaxpr_classes():
+    from jax.extend import core as jex_core
+
+    return (jex_core.Jaxpr, jex_core.ClosedJaxpr)
+
+
+def iter_eqns(jaxpr):
+    """Depth-first over every equation including sub-jaxprs nested in
+    params (pjit/while/scan/cond/shard_map/custom_* all stash theirs
+    there, in varying containers)."""
+    jaxpr_cls, closed_cls = _jaxpr_classes()
+
+    def walk(j):
+        if isinstance(j, closed_cls):
+            j = j.jaxpr
+        for eqn in j.eqns:
+            yield eqn
+            for val in eqn.params.values():
+                for sub in _subjaxprs(val):
+                    yield from walk(sub)
+
+    def _subjaxprs(val):
+        if isinstance(val, (jaxpr_cls, closed_cls)):
+            yield val
+        elif isinstance(val, (tuple, list)):
+            for item in val:
+                yield from _subjaxprs(item)
+
+    yield from walk(jaxpr)
+
+
+def _avals_of(jaxpr):
+    """Every abstract value in the jaxpr: top-level binders plus each
+    equation's operands and results (literals included — a 64-bit
+    constant is as much a violation as a 64-bit operand)."""
+    seen = []
+    for v in list(jaxpr.jaxpr.invars) + list(jaxpr.jaxpr.outvars):
+        aval = getattr(v, "aval", None)
+        if aval is not None:
+            seen.append(aval)
+    for eqn in iter_eqns(jaxpr):
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if aval is not None:
+                seen.append(aval)
+    return seen
+
+
+def _signature_avals(jaxpr):
+    """The entry's own inputs and outputs (the caller-visible contract)."""
+    out = []
+    for v in list(jaxpr.jaxpr.invars) + list(jaxpr.jaxpr.outvars):
+        aval = getattr(v, "aval", None)
+        if aval is not None:
+            out.append(aval)
+    return out
+
+
+def audit_entry(entry) -> list[Violation]:
+    """Trace one registry entry and apply rules J1-J6."""
+    import jax
+
+    violations: list[Violation] = []
+    try:
+        spec = entry.spec()
+    except Exception:
+        return [Violation(
+            entry.name, "spec-error",
+            f"audit spec failed to build:\n{traceback.format_exc(limit=4)}",
+        )]
+    fn = spec.fn if spec.fn is not None else entry.fn
+    if fn is None:
+        return [Violation(
+            entry.name, "spec-error", "no callable registered or built"
+        )]
+    try:
+        closed = jax.make_jaxpr(
+            lambda *args: fn(*args, **spec.kwargs)
+        )(*spec.args)
+    except Exception:
+        return [Violation(
+            entry.name, "trace-error",
+            f"abstract trace failed:\n{traceback.format_exc(limit=4)}",
+        )]
+
+    avals = _avals_of(closed)
+
+    # J1 / J2 / J5 — dtype and shape discipline over every aval.
+    flagged_dtypes = set()
+    for aval in avals:
+        dtype = getattr(aval, "dtype", None)
+        shape = getattr(aval, "shape", ())
+        if dtype is not None:
+            name = str(dtype)
+            if name in FORBIDDEN_64BIT and name not in flagged_dtypes:
+                flagged_dtypes.add(name)
+                violations.append(Violation(
+                    entry.name, "forbid-64bit",
+                    f"{name} value of shape {tuple(shape)} in traced "
+                    "graph — x64 must stay off (uint32 counter-hash "
+                    "coins change bits under x64)",
+                ))
+            if (
+                spec.integer_only
+                and name.startswith(("float", "bfloat", "complex"))
+                and name not in flagged_dtypes
+            ):
+                flagged_dtypes.add(name)
+                violations.append(Violation(
+                    entry.name, "integer-only",
+                    f"inexact dtype {name} (shape {tuple(shape)}) in an "
+                    "integer/bitwise kernel — weak-type promotion from a "
+                    "stray Python float?",
+                ))
+        for dim in shape:
+            if not isinstance(dim, int):
+                violations.append(Violation(
+                    entry.name, "static-shapes",
+                    f"non-static dimension {dim!r} in shape "
+                    f"{tuple(shape)} — every XLA compilation must see "
+                    "static shapes",
+                ))
+                break
+
+    # J3 / J4 — forbidden primitives.
+    flagged_prims = set()
+    for eqn in iter_eqns(closed):
+        name = eqn.primitive.name
+        if name in HOST_CALLBACK_PRIMITIVES and name not in flagged_prims:
+            flagged_prims.add(name)
+            violations.append(Violation(
+                entry.name, "no-host-callback",
+                f"host callback primitive '{name}' — a callback inside a "
+                "compiled tick loop serializes every iteration on the host",
+            ))
+        if name in TRANSFER_PRIMITIVES and name not in flagged_prims:
+            flagged_prims.add(name)
+            violations.append(Violation(
+                entry.name, "no-device-put",
+                f"'{name}' inside the traced graph — stage operands "
+                "before the jit boundary, not per call",
+            ))
+
+    # J6 — bitmask word-width contract (signature avals only).
+    if spec.bitmask_words is not None:
+        allowed = spec.bitmask_words
+        if isinstance(allowed, int):
+            allowed = (allowed,)
+        allowed = set(allowed)
+        bad = set()
+        for aval in _signature_avals(closed):
+            dtype = getattr(aval, "dtype", None)
+            shape = tuple(getattr(aval, "shape", ()))
+            if (
+                dtype is not None
+                and str(dtype) == "uint32"
+                and len(shape) >= 2
+                and shape[-1] not in allowed
+                and shape not in bad
+            ):
+                bad.add(shape)
+                violations.append(Violation(
+                    entry.name, "bitmask-words",
+                    f"uint32 array of shape {shape} packs its minor axis "
+                    f"to {shape[-1]} words; this entry's declared word "
+                    f"widths are {sorted(allowed)} "
+                    "(ops/bitmask.py packing contract: slot s lives at "
+                    "word s // 32)",
+                ))
+    return violations
+
+
+def run_audit(entries=None) -> dict:
+    """Audit every registered entry. Returns a JSON-ready report:
+    {"ok", "entries_audited", "entries", "violations": [...]}. Importing
+    the registry's population list is the caller's job only when a
+    custom ``entries`` iterable is NOT given."""
+    if entries is None:
+        from p2p_gossip_tpu.staticcheck import entrypoints, registry
+
+        entrypoints.load_all()
+        entries = registry.all_entries()
+    violations: list[Violation] = []
+    names = []
+    for entry in entries:
+        names.append(entry.name)
+        violations.extend(audit_entry(entry))
+    return {
+        "ok": not violations,
+        "entries_audited": len(names),
+        "entries": names,
+        "violations": [v.as_dict() for v in violations],
+    }

@@ -1,0 +1,168 @@
+"""Recompile sentinel — one compilation per distinct sweep-grid signature.
+
+The campaign engine's whole throughput story rests on "one compile
+serves every cell that shares shapes" (batch/sweep.py): a weak-type
+drift (int64 seed array where the kernel saw uint32), a pad-width
+wobble, or a static arg that silently varies per cell multiplies the
+sweep's wall by the compile cost — and on a TPU tunnel window, burns
+the window. Nothing caught that before: XLA recompiles silently.
+
+The sentinel replays a small grid through the REAL sweep runner
+(`batch.sweep.run_sweep`) and counts jit-cache misses on the registered
+campaign kernels (the ``count_compiles`` entries in the staticcheck
+registry — jit wrappers expose ``_cache_size``). The expected count per
+kernel is computed from the grid spec by the same static-signature
+rules the kernels declare (`expected_compiles`); measured != expected
+fails, in either direction — an over-count is a recompile leak, an
+under-count means the expectation model drifted from the kernels and
+must be fixed here, not suppressed.
+
+``jax.clear_caches()`` runs before the replay so prior compilations in
+the process (tests, earlier stages) can't mask a miss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+#: Kernel-name aliases: registry entry name -> short report key.
+_KERNELS = {
+    "batch.campaign._run_coverage_batch": "coverage_batch",
+    "batch.campaign._run_while_batch": "while_batch",
+    "models.protocols._run_pushpull_replicas": "pushpull_replicas",
+    "models.protocols._run_pushk_replicas": "pushk_replicas",
+}
+
+
+def default_grid() -> dict:
+    """The shipped replay grid: 6 cells spanning the flood campaign and
+    the batched Demers trio, with a loss axis (static threshold — each
+    distinct lossProb is one legitimate compile) — small enough for
+    tier-1 (~4 s on CPU) while exercising every counted kernel."""
+    return {
+        "numNodes": 64,
+        "p": 0.1,
+        "shares": 2,
+        "horizon": 16,
+        "replicas": 4,
+        "protocol": ["push", "pushpull", "pushk"],
+        "fanout": [2],
+        "lossProb": [0.0, 0.1],
+    }
+
+
+def expected_compiles(spec: dict) -> dict[str, int]:
+    """Distinct compile signatures per counted kernel for ``spec``.
+
+    Mirrors the static/shape config the sweep path derives per cell:
+    the kernel a protocol routes to, the graph knob ``p`` (changes ELL
+    operand shapes), the loss THRESHOLD (static in every kernel; the
+    flood path also bakes the seed — both derive from lossProb/baseSeed),
+    churn presence (changes the operand pytree structure), fanout
+    (static, pushk only), the anti-entropy mode, and the shared scalar
+    shape knobs. A signature set per kernel; the expected count is its
+    size. If a kernel gains a new static arg that varies per cell, add
+    it HERE — the sentinel failing "under-compiled expectation" is the
+    reminder."""
+    from p2p_gossip_tpu.batch.sweep import expand_grid
+
+    sigs: dict[str, set] = {k: set() for k in _KERNELS.values()}
+    for cell in expand_grid(spec):
+        shape_sig = (
+            cell["numNodes"], cell["p"], cell["shares"], cell["horizon"],
+            _replica_count(cell), cell["baseSeed"],
+        )
+        loss_sig = cell["lossProb"]
+        churn_sig = cell["churnProb"] > 0.0
+        if cell["protocol"] == "push":
+            sigs["coverage_batch"].add((shape_sig, loss_sig, churn_sig))
+        elif cell["protocol"] in ("pushpull", "pull"):
+            sigs["pushpull_replicas"].add(
+                (shape_sig, loss_sig, churn_sig, cell["protocol"])
+            )
+        elif cell["protocol"] == "pushk":
+            sigs["pushk_replicas"].add(
+                (shape_sig, loss_sig, churn_sig, cell["fanout"])
+            )
+    return {k: len(v) for k, v in sigs.items()}
+
+
+def _replica_count(cell) -> int:
+    reps = cell["replicas"]
+    return len(reps) if isinstance(reps, list) else int(reps)
+
+
+def _counted_kernels() -> dict[str, object]:
+    from p2p_gossip_tpu.staticcheck import entrypoints, registry
+
+    entrypoints.load_all()
+    out = {}
+    for entry in registry.countable_entries():
+        key = _KERNELS.get(entry.name, entry.name)
+        out[key] = entry.jit_target()
+    return out
+
+
+@dataclasses.dataclass
+class SentinelReport:
+    ok: bool
+    expected: dict[str, int]
+    measured: dict[str, int]
+    cells: int
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def violations(self) -> list[str]:
+        out = []
+        for k in sorted(set(self.expected) | set(self.measured)):
+            e, m = self.expected.get(k, 0), self.measured.get(k, 0)
+            if m > e:
+                out.append(
+                    f"recompile-sentinel: kernel '{k}' compiled {m}x for "
+                    f"{e} distinct grid signature(s) — a static arg or "
+                    "operand shape/dtype drifts between calls that should "
+                    "share one executable"
+                )
+            elif m < e:
+                out.append(
+                    f"recompile-sentinel: kernel '{k}' compiled {m}x but "
+                    f"the grid model expected {e} — expected_compiles() "
+                    "drifted from the kernels; fix the model"
+                )
+        return out
+
+
+def run_sentinel(spec: dict | None = None) -> SentinelReport:
+    """Clear jit caches, replay ``spec`` through the real sweep runner,
+    and compare per-kernel cache sizes against ``expected_compiles``."""
+    import jax
+
+    from p2p_gossip_tpu.batch.sweep import expand_grid, run_sweep
+
+    if spec is None:
+        spec = default_grid()
+    kernels = _counted_kernels()
+    expected = expected_compiles(spec)
+    jax.clear_caches()
+    run_sweep(spec)
+    measured = {
+        name: int(fn._cache_size()) for name, fn in kernels.items()
+    }
+    ok = all(
+        measured.get(k, 0) == expected.get(k, 0)
+        for k in set(expected) | set(measured)
+    )
+    return SentinelReport(
+        ok=ok, expected=expected, measured=measured,
+        cells=len(expand_grid(spec)),
+    )
+
+
+def measure_compiles(fn_or_name):
+    """Current cache size of a counted kernel (test helper)."""
+    kernels = _counted_kernels()
+    if isinstance(fn_or_name, str):
+        return int(kernels[fn_or_name]._cache_size())
+    return int(fn_or_name._cache_size())

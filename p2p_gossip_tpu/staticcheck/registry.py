@@ -1,0 +1,113 @@
+"""Audit-entry registry — the one list of compiled surfaces to check.
+
+Every public jit entry point (engine kernels, campaign runners, sharded
+runners, ops primitives) registers here, either with the ``audited``
+decorator on the function itself or an explicit ``register_entry`` call
+for factory-built runners. The jaxpr auditor iterates the registry, so a
+new engine that registers is audited by default — and one that doesn't
+shows up as a coverage gap in the CLI's entry list rather than silently
+skipping the gate.
+
+Import-light on purpose: no jax at module scope, specs are built lazily
+(the ``spec`` argument is a zero-arg callable evaluated only when the
+auditor runs), so decorating a kernel costs one dict insert at import.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+if typing.TYPE_CHECKING:  # pragma: no cover - typing only
+    from collections.abc import Callable
+
+
+@dataclasses.dataclass
+class AuditSpec:
+    """How to abstract-trace one entry point.
+
+    ``args``/``kwargs`` are the concrete example operands (tiny shapes —
+    the audit is abstract, values never run). ``fn`` overrides the
+    registered callable for factory-built runners whose compiled object
+    only exists once the spec builder has staged a mesh.
+
+    ``integer_only`` asserts the traced computation carries no inexact
+    dtype anywhere — the weak-type-promotion guard for the bitwise tick
+    kernels, where a stray Python float silently upcasts whole counter
+    chains to f32. ``bitmask_words`` asserts every uint32 operand/result
+    of rank >= 2 in the entry's signature packs its minor axis to exactly
+    that word count (ops/bitmask.py's ``num_words`` contract — slot s
+    lives at word s // 32, so a mismatched minor axis means slots are
+    silently truncated or padded into a different share universe).
+    """
+
+    args: tuple
+    kwargs: dict = dataclasses.field(default_factory=dict)
+    fn: "Callable | None" = None
+    integer_only: bool = False
+    bitmask_words: int | None = None
+
+
+@dataclasses.dataclass
+class AuditEntry:
+    name: str
+    fn: "Callable | None"
+    spec: "Callable[[], AuditSpec]"
+    count_compiles: bool = False
+
+    def jit_target(self):
+        """The object whose executable cache the recompile sentinel
+        counts (jit-wrapped callables expose ``_cache_size``)."""
+        return self.fn
+
+
+_REGISTRY: dict[str, AuditEntry] = {}
+
+
+def register_entry(
+    name: str,
+    fn=None,
+    *,
+    spec,
+    count_compiles: bool = False,
+) -> None:
+    """Register ``fn`` (or a spec-built runner when ``fn`` is None) under
+    ``name``. ``spec`` is a zero-arg callable returning an AuditSpec —
+    evaluated lazily at audit time, so it may reference module globals
+    defined after the registration site. Re-registration under the same
+    name replaces (module reloads in tests)."""
+    _REGISTRY[name] = AuditEntry(
+        name=name, fn=fn, spec=spec, count_compiles=count_compiles
+    )
+
+
+def audited(name: str, *, spec, count_compiles: bool = False):
+    """Decorator form of ``register_entry`` for directly-defined kernels:
+
+        @audited("engine.sync._run_chunk_while", spec=lambda: _spec())
+        @functools.partial(jax.jit, static_argnames=(...))
+        def _run_chunk_while(...): ...
+
+    Returns the function unchanged (stacks above ``jax.jit`` so the
+    registered object is the jit wrapper the sentinel can count).
+    """
+
+    def deco(fn):
+        register_entry(name, fn, spec=spec, count_compiles=count_compiles)
+        return fn
+
+    return deco
+
+
+def all_entries() -> tuple[AuditEntry, ...]:
+    """Registered entries in name order (deterministic reports)."""
+    return tuple(_REGISTRY[k] for k in sorted(_REGISTRY))
+
+
+def get_entry(name: str) -> AuditEntry:
+    return _REGISTRY[name]
+
+
+def countable_entries() -> tuple[AuditEntry, ...]:
+    """Entries whose jit cache the recompile sentinel tracks."""
+    return tuple(e for e in all_entries() if e.count_compiles)

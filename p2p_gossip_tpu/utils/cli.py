@@ -23,6 +23,11 @@ import numpy as np
 
 from p2p_gossip_tpu.models import topology as topo
 from p2p_gossip_tpu.models.generation import poisson_schedule, uniform_renewal_schedule
+from p2p_gossip_tpu.models.seeds import (
+    churn_stream_seed,
+    loss_stream_seed,
+    replica_loss_seeds,
+)
 from p2p_gossip_tpu.models.latency import (
     lognormal_delays,
     serialization_delays,
@@ -453,7 +458,7 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
 def _run_campaign_cli(args, g, horizon, delays, loss) -> int:
     """--replicas R: a seed-ensemble campaign in one jit. Replica r's
     schedule, churn AND link-loss stream derive from seed (--seed + r)
-    with the solo CLI's stream offsets (+7919 churn, +104729 loss), so
+    with the solo CLI's stream offsets (models/seeds.py), so
     any single replica is bitwise-reproducible as a solo ``--seed
     (--seed + r)`` run. Every protocol batches: push through the flood
     campaign kernels, pushpull/pull/pushk through
@@ -472,11 +477,9 @@ def _run_campaign_cli(args, g, horizon, delays, loss) -> int:
     from p2p_gossip_tpu.models.protocols import PullCreditBoundError
 
     seeds = [args.seed + r for r in range(args.replicas)]
-    # Per-replica erasure streams: the same +104729 offset the solo CLI
-    # applies to --seed, one per replica seed.
-    loss_seeds = (
-        [s + 104729 for s in seeds] if loss is not None else None
-    )
+    # Per-replica erasure streams: the same loss-stream offset the solo
+    # CLI applies to --seed, one per replica seed (models/seeds.py).
+    loss_seeds = replica_loss_seeds(seeds) if loss is not None else None
     ckpt_kw = dict(
         checkpoint_path=args.checkpoint or None,
         checkpoint_every=args.checkpointEvery,
@@ -900,7 +903,7 @@ def run(argv=None) -> int:
         from p2p_gossip_tpu.models.linkloss import LinkLossModel
 
         # Offset seed: independent of the topology/schedule/churn streams.
-        loss = LinkLossModel(args.lossProb, seed=args.seed + 104729)
+        loss = LinkLossModel(args.lossProb, seed=loss_stream_seed(args.seed))
 
     churn = None
     if not 0.0 <= args.churnProb <= 1.0:
@@ -919,7 +922,7 @@ def run(argv=None) -> int:
             outage_prob=args.churnProb,
             mean_down_ticks=max(args.churnDowntime / tick_dt, 1.0),
             max_outages=args.churnOutages,
-            seed=args.seed + 7919,
+            seed=churn_stream_seed(args.seed),
         )
 
     if loaded_graph is not None:

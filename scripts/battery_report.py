@@ -213,6 +213,37 @@ def main() -> int:
             ]))
             print()
 
+    sc = by_stage.get("staticcheck")
+    if sc and sc["results"]:
+        rep = sc["results"][-1]
+        comp = rep.get("compile") or {}
+        comp_entries = comp.get("entries", [])
+        print("## Static analysis (jaxpr audit + recompile sentinel + "
+              "lint, on-chip compile leg)\n")
+        print(md_table([{
+            "ok": rep.get("ok"),
+            "platform": rep.get("platform"),
+            "entries_audited": (rep.get("jaxpr") or {}).get(
+                "entries_audited"),
+            "lint_files": (rep.get("lint") or {}).get("files_scanned"),
+            "sweep_cells": (rep.get("recompile") or {}).get("cells"),
+            "compiled_clean": (
+                f"{sum(1 for r in comp_entries if r.get('ok'))}/"
+                f"{len(comp_entries)}" if comp_entries else None
+            ),
+            "violations": rep.get("violations_total"),
+            "wall_s": rep.get("wall_s"),
+        }], [
+            "ok", "platform", "entries_audited", "lint_files",
+            "sweep_cells", "compiled_clean", "violations", "wall_s",
+        ]))
+        failed_compiles = [r for r in comp_entries if not r.get("ok")]
+        if failed_compiles:
+            print("\nentries failing on-chip compile:")
+            for r in failed_compiles:
+                print(f"- `{r['entry']}`: {r.get('error', '?')}")
+        print()
+
     prof = by_stage.get("profile")
     if prof and prof["results"]:
         summaries = [

@@ -1,15 +1,26 @@
 #!/usr/bin/env bash
 # Tier-1 gate as one command — the EXACT verify line from ROADMAP.md,
-# plus a pre-flight check that the `slow` marker is registered (an
-# unregistered marker makes `-m 'not slow'` silently rely on pytest's
-# default-warn behavior; registration lives in pyproject.toml).
+# preceded by the static-analysis gate (scripts/staticcheck.py: jaxpr
+# invariant audit + recompile sentinel + AST lint over every registered
+# entry point) and a pre-flight check that the `slow` marker is
+# registered (an unregistered marker makes `-m 'not slow'` silently
+# rely on pytest's default-warn behavior; registration lives in
+# pyproject.toml).
 #
 #   ./scripts/ci_tier1.sh
 #
-# Exit code is pytest's. DOTS_PASSED echoes the passed-dot count the
-# driver greps for.
+# Exit code is pytest's (or 1 if staticcheck finds a violation).
+# DOTS_PASSED echoes the passed-dot count the driver greps for.
 set -u
 cd "$(dirname "$0")/.."
+
+# Static-analysis gate first: cheap (~10 s on CPU), and a dirty tree
+# should fail before the 6-minute pytest pass, not after.
+if ! JAX_PLATFORMS=cpu python scripts/staticcheck.py --json; then
+  echo "ci_tier1: FAIL — staticcheck violations (run" \
+       "'python scripts/staticcheck.py' for the human report)" >&2
+  exit 1
+fi
 
 # Marker registration check: `pytest --markers` must list `slow`.
 if ! JAX_PLATFORMS=cpu python -m pytest --markers -p no:cacheprovider 2>/dev/null \

@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Sanitizer leg for the native baseline (staticcheck's C++ counterpart):
+# build native/gossip_native.cc with -Wall -Wextra -Werror and
+# -fsanitize=address,undefined, then run the native parity suite
+# (tests/test_native.py) against the instrumented library via the
+# P2P_NATIVE_LIB override (runtime/native.py).
+#
+#   ./scripts/native_asan.sh
+#
+# Exit 0 iff the build is warning-free AND every test passes with no
+# sanitizer report. The python interpreter itself is uninstrumented, so
+# libasan is LD_PRELOADed; detect_leaks=0 because CPython intentionally
+# leaks interned state at exit — the target is the .so's heap/UB
+# discipline, not the interpreter's.
+set -u
+cd "$(dirname "$0")/.."
+
+CXX=${CXX:-g++}
+OUT="native/.libgossip_native.asan.so"
+
+if ! make -C native asan CXX="$CXX" ASAN_OUT="$(basename "$OUT")"; then
+  echo "native_asan: FAIL — build error or warning (-Werror)" >&2
+  exit 1
+fi
+
+libasan=$("$CXX" -print-file-name=libasan.so)
+if [ ! -e "$libasan" ]; then
+  echo "native_asan: FAIL — libasan runtime not found ($libasan)" >&2
+  exit 1
+fi
+
+# P2P_SANITIZER_RUN gates the two jnp-engine parity tests: jaxlib aborts
+# when XLA compiles under a preloaded ASan runtime (not this repo's
+# code). The pure-host partnered parity test keeps the C++ partnered
+# paths exercised here; the jnp legs run in every regular tier-1 pass.
+run_env=(
+  "LD_PRELOAD=$libasan"
+  "ASAN_OPTIONS=detect_leaks=0:abort_on_error=1"
+  "UBSAN_OPTIONS=print_stacktrace=1:halt_on_error=1"
+  "P2P_NATIVE_LIB=$PWD/$OUT"
+  "P2P_SANITIZER_RUN=1"
+  "JAX_PLATFORMS=cpu"
+)
+
+# Preflight: the suite must actually bind the INSTRUMENTED library — a
+# load failure would fall back (or skip) and green-wash the leg.
+if ! env "${run_env[@]}" python - <<'EOF'
+import os, sys
+sys.path.insert(0, os.getcwd())
+from p2p_gossip_tpu.runtime import native
+lib = native.load_library()
+want = os.environ["P2P_NATIVE_LIB"]
+assert lib is not None, "instrumented library failed to load"
+assert getattr(lib, "_name", None) == want, (
+    f"loaded {getattr(lib, '_name', None)!r}, wanted the instrumented "
+    f"{want!r}"
+)
+print(f"native_asan: bound {want}", file=sys.stderr)
+EOF
+then
+  echo "native_asan: FAIL — instrumented library did not bind" >&2
+  rm -f "$OUT"
+  exit 1
+fi
+
+env "${run_env[@]}" python -m pytest tests/test_native.py -q \
+  -p no:cacheprovider
+rc=$?
+rm -f "$OUT"
+if [ $rc -ne 0 ]; then
+  echo "native_asan: FAIL — test or sanitizer report (rc=$rc)" >&2
+else
+  echo "native_asan: OK — warning-free build, suite green under" \
+       "ASan+UBSan" >&2
+fi
+exit $rc

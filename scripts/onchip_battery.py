@@ -26,6 +26,14 @@ Stages (value-first within safety bands — see the note after the list):
                before any 1M or Pallas stage. Today's campaign numbers
                are CPU-only (docs/artifacts/campaign_accept_cpu.jsonl,
                protocol_campaign_accept_cpu.jsonl).
+  staticcheck — staticcheck.py --json --compile -> the static-analysis
+               gate's on-chip leg: the jaxpr audit + recompile sentinel
+               run as on CPU, and every registered entry point is
+               additionally lowered + compiled on the real chip (an
+               entry that audits clean can still fail Mosaic/XLA on
+               hardware shapes). Standard XLA compiles only — no
+               execution at scale — so it sits in the safe band after
+               campaign and before any 1M stage.
   scale1m   — scale_1m.py --shares 64 --chunk 64 -> the 1M ER on-chip
                line at the minimal resident footprint (pad W=2, ~5.2 GB
                modeled = essentially the bare ELL). The full-config
@@ -100,7 +108,7 @@ ART_DIR = os.path.join(REPO, "docs", "artifacts")
 
 STAGE_ORDER = (
     "bench", "protocols", "kernel", "bench_rep2", "bench_rep3",
-    "campaign",
+    "campaign", "staticcheck",
     "scale1m", "scale1m_ba", "sweep250", "profile", "scale1m_full",
 )
 
@@ -194,6 +202,16 @@ def stage_specs(args) -> dict:
                 "argv": [
                     py, os.path.join(SCRIPTS, "profile_capture.py"),
                     "--smoke", "--art-dir", args.art_dir,
+                ],
+                "env": cpu,
+                "budget": args.stage_budget or 900,
+            },
+            "staticcheck": {
+                # Full gate incl. the --compile leg, on host CPU: the
+                # smoke run proves the stage machinery and record shape.
+                "argv": [
+                    py, os.path.join(SCRIPTS, "staticcheck.py"),
+                    "--json", "--compile",
                 ],
                 "env": cpu,
                 "budget": args.stage_budget or 900,
@@ -295,6 +313,17 @@ def stage_specs(args) -> dict:
                 "--sweep", os.path.join(REPO, "examples",
                                         "campaign_accept.json"),
                 "--compare-sequential", "--no-report",
+            ],
+            "env": sweep_env,
+            "budget": args.stage_budget or 1800,
+        },
+        "staticcheck": {
+            # On-chip leg of the static-analysis gate: audit + sentinel
+            # as on CPU, plus lower+compile of every registered entry on
+            # the real chip. Compiles only — nothing executes at scale.
+            "argv": [
+                py, os.path.join(SCRIPTS, "staticcheck.py"),
+                "--json", "--compile",
             ],
             "env": sweep_env,
             "budget": args.stage_budget or 1800,

@@ -58,6 +58,7 @@ def _compare_sequential(record: dict) -> dict | None:
     from p2p_gossip_tpu.batch.sweep import _DEFAULTS, _build_graph, _cell_loss
     from p2p_gossip_tpu.engine.sync import DeviceGraph, run_flood_coverage
     from p2p_gossip_tpu.models.churn import random_churn
+    from p2p_gossip_tpu.models.seeds import churn_stream_seed
 
     # The record's cell dict carries only the reported keys; restore the
     # sweep defaults for the ones it omits (churn knobs, baseSeed).
@@ -133,7 +134,7 @@ def _compare_sequential(record: dict) -> dict | None:
         churn = (
             random_churn(
                 graph.n, cell["horizon"], outage_prob=cell["churnProb"],
-                mean_down_ticks=10.0, seed=int(seed) + 7919,
+                mean_down_ticks=10.0, seed=churn_stream_seed(seed),
             )
             if cell["churnProb"] > 0.0
             else None
