@@ -107,8 +107,19 @@ def main() -> None:
     import jax
 
     import p2p_gossip_tpu as pg
+    from p2p_gossip_tpu import telemetry
     from p2p_gossip_tpu.engine.sync import DeviceGraph, run_sync_sim
     from p2p_gossip_tpu.runtime import native
+
+    # Host-span telemetry for every bench run: phase timings ride the
+    # JSON row (and stream to P2P_TELEMETRY when set). Device metric
+    # rings stay OFF regardless — they change the compiled program, and
+    # the headline number must measure the uninstrumented kernels
+    # (docs/OBSERVABILITY.md). Ring-instrumented runs are the CLI's /
+    # battery telemetry stage's job.
+    telemetry.configure(
+        os.environ.get("P2P_TELEMETRY") or None, rings=False,
+    )
 
     smoke = os.environ.get("P2P_BENCH_SMOKE") == "1"
     if smoke:
@@ -132,9 +143,10 @@ def main() -> None:
 
     log(f"devices: {jax.devices()}")
     t0 = time.perf_counter()
-    graph = native.native_erdos_renyi(n, p, seed=seed)
-    if graph is None:
-        graph = pg.erdos_renyi(n, p, seed=seed)
+    with telemetry.span("build_graph", n=n):
+        graph = native.native_erdos_renyi(n, p, seed=seed)
+        if graph is None:
+            graph = pg.erdos_renyi(n, p, seed=seed)
     log(
         f"graph: N={graph.n} edges={graph.num_edges} dmax={graph.max_degree} "
         f"({time.perf_counter() - t0:.1f}s)"
@@ -147,11 +159,15 @@ def main() -> None:
         rng.integers(0, gen_window, n_shares).astype(np.int32),
     )
 
-    dg = DeviceGraph.build(graph)
-    jax.block_until_ready(dg.ell_idx)
+    with telemetry.span("stage"):
+        dg = DeviceGraph.build(graph)
+        jax.block_until_ready(dg.ell_idx)
 
     t0 = time.perf_counter()
-    warm = run_sync_sim(graph, sched, horizon, chunk_size=chunk_size, device_graph=dg)
+    with telemetry.span("warmup_compile"):
+        warm = run_sync_sim(
+            graph, sched, horizon, chunk_size=chunk_size, device_graph=dg
+        )
     log(f"warmup (incl. compile): {time.perf_counter() - t0:.1f}s")
 
     profile_dir = os.environ.get("P2P_BENCH_PROFILE_DIR", "")
@@ -171,15 +187,18 @@ def main() -> None:
         import jax.profiler
 
         with jax.profiler.trace(profile_dir):
-            stats = run_sync_sim(
-                graph, sched, horizon, chunk_size=chunk_size, device_graph=dg
-            )
+            with telemetry.span("execute"):
+                stats = run_sync_sim(
+                    graph, sched, horizon, chunk_size=chunk_size,
+                    device_graph=dg,
+                )
             tpu_wall = time.perf_counter() - t0
         log(f"profiler trace written to {profile_dir}")
     else:
-        stats = run_sync_sim(
-            graph, sched, horizon, chunk_size=chunk_size, device_graph=dg
-        )
+        with telemetry.span("execute"):
+            stats = run_sync_sim(
+                graph, sched, horizon, chunk_size=chunk_size, device_graph=dg
+            )
         tpu_wall = time.perf_counter() - t0
     processed = stats.totals()["processed"]
     assert stats.totals() == warm.totals()
@@ -221,7 +240,8 @@ def main() -> None:
         sched.gen_ticks[:base_shares].copy(),
     )
     t0 = time.perf_counter()
-    base = native.run_native_sim(graph, base_sched, horizon)
+    with telemetry.span("baseline"):
+        base = native.run_native_sim(graph, base_sched, horizon)
     base_wall = time.perf_counter() - t0
     base_processed = base.totals()["processed"]
     base_rate = base_processed / base_wall
@@ -254,7 +274,8 @@ def main() -> None:
     camp_graph = pg.erdos_renyi(camp_n, camp_p, seed=seed)
     camp_reps = flood_replicas(camp_graph, camp_s, list(range(camp_r)), camp_h)
     t0 = time.perf_counter()
-    camp = run_coverage_campaign(camp_graph, camp_reps, camp_h)
+    with telemetry.span("campaign", replicas=camp_r):
+        camp = run_coverage_campaign(camp_graph, camp_reps, camp_h)
     camp_wall = time.perf_counter() - t0  # includes the one compile
     camp_processed = int((camp.generated + camp.received).sum())
     camp_rate = camp_processed / camp_wall
@@ -301,9 +322,10 @@ def main() -> None:
     from p2p_gossip_tpu.models.protocols import run_pushpull_sim
 
     t0 = time.perf_counter()
-    pcamp = run_protocol_campaign(
-        camp_graph, camp_reps, camp_h, protocol="pushpull"
-    )
+    with telemetry.span("protocol_campaign", replicas=camp_r):
+        pcamp = run_protocol_campaign(
+            camp_graph, camp_reps, camp_h, protocol="pushpull"
+        )
     pcamp_wall = time.perf_counter() - t0  # includes the one compile
     t0 = time.perf_counter()
     run_protocol_campaign(camp_graph, camp_reps, camp_h, protocol="pushpull")
@@ -390,7 +412,12 @@ def main() -> None:
         # measured_hbm_bytes can calibrate the model bytes-to-bytes on
         # one clock (profile_capture.py) instead of via bandwidth ratios
         # whose denominators differ (device busy time vs bench wall).
-        "modeled_bytes_total": round(bytes_tick * ticks),
+        # Nulled on CPU-fallback/smoke rows for the same ingestion-safety
+        # reason as pct_hbm_peak: the modeled figure corresponds to no
+        # calibratable on-chip pass there (round-5 advisor finding).
+        "modeled_bytes_total": (
+            round(bytes_tick * ticks) if not (cpu_fallback or smoke) else None
+        ),
         # True/False from the host-CPU audit subprocess; None when the
         # audit itself could not run (never silently green).
         "staticcheck_ok": staticcheck_ok,
@@ -420,6 +447,23 @@ def main() -> None:
         "sequential_warm_loop_s": round(pp_seq_warm, 4),
         "speedup_incl_compile": round(pp_seq_warm / pcamp_wall, 2),
         "speedup_warm_vs_warm_loop": round(pp_seq_warm / pcamp_warm, 2),
+    }
+    # Span telemetry rides the row so the battery archives phase timings
+    # alongside perf: event count plus total span seconds by phase name
+    # (spans only — device rings stay off in bench, see the configure
+    # call above). ``stream`` names the JSONL file when P2P_TELEMETRY
+    # directed one.
+    telemetry.emit_jit_cache_counters()
+    span_s: dict = {}
+    for ev in telemetry.events():
+        if ev.get("type") == "span":
+            span_s[ev["name"]] = round(
+                span_s.get(ev["name"], 0.0) + ev["dur"], 4
+            )
+    row["telemetry"] = {
+        "events": telemetry.event_count(),
+        "span_s_by_phase": span_s,
+        "stream": telemetry.path(),
     }
     if profile_dir:
         # Tracing adds per-op overhead: mark the row so artifact pickers

@@ -70,7 +70,9 @@ from p2p_gossip_tpu.models.generation import Schedule, uniform_renewal_schedule
 from p2p_gossip_tpu.models.seeds import churn_stream_seed
 from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
-from p2p_gossip_tpu.staticcheck.registry import audited
+from p2p_gossip_tpu.staticcheck.registry import audited, register_entry
+from p2p_gossip_tpu import telemetry
+from p2p_gossip_tpu.telemetry import rings as tel_rings
 from p2p_gossip_tpu.utils import logging as p2plog
 from p2p_gossip_tpu.utils.stats import NodeStats
 
@@ -292,7 +294,7 @@ def _shard_batch(mesh, arrays):
 
 def _batched_tick(dg, block, t, seen, hist, received, sent,
                   origins_b, gen_ticks_b, churn_b, slots, loss,
-                  loss_seeds_b=None):
+                  loss_seeds_b=None, telemetry_on: bool = False):
     """One global tick over the whole (B, ...) replica batch: ``vmap`` of
     the solo engine's ``_tick_body`` (which carries the shared counter
     semantics) over the replica axis, at a COMMON tick counter ``t``.
@@ -307,10 +309,20 @@ def _batched_tick(dg, block, t, seen, hist, received, sent,
 
     ``loss_seeds_b`` (optional (B,) uint32) vmaps a per-replica loss seed
     into the gather's erasure coin; ``loss`` is then (threshold, None).
+    ``telemetry_on`` (static) additionally returns the per-replica
+    (B, NUM_METRICS) metric rows the batched kernels write into their
+    rings — vmap of the solo tick's row, so replica r's telemetry equals
+    its solo run's.
     """
 
     def tick_one(seen, hist, received, sent, origins, gen_ticks, churn,
                  lseed=None):
+        if telemetry_on:
+            (_, seen, hist, received, sent), met = _tick_body(
+                dg, block, (t, seen, hist, received, sent), origins, slots,
+                gen_ticks, churn, loss, 0, lseed, telemetry=True,
+            )
+            return seen, hist, received, sent, met
         _, seen, hist, received, sent = _tick_body(
             dg, block, (t, seen, hist, received, sent), origins, slots,
             gen_ticks, churn, loss, 0, lseed,
@@ -341,7 +353,10 @@ def _batched_tick(dg, block, t, seen, hist, received, sent,
 )
 @functools.partial(
     jax.jit,
-    static_argnames=("chunk_size", "horizon", "block", "loss", "coverage_slots"),
+    static_argnames=(
+        "chunk_size", "horizon", "block", "loss", "coverage_slots",
+        "telemetry",
+    ),
 )
 def _run_coverage_batch(
     dg: DeviceGraph,
@@ -355,13 +370,18 @@ def _run_coverage_batch(
     block: int,
     loss: tuple | None = None,
     coverage_slots: int | None = None,
+    telemetry: bool = False,
 ):
     """Coverage-recording replica batch — the campaign counterpart of
     ``engine.sync._run_chunk_coverage`` with a leading replica axis on
     every piece of loop state. Pallas coverage stays off: the kernel's
-    batching rule is unvalidated on hardware (ROADMAP open item)."""
+    batching rule is unvalidated on hardware (ROADMAP open item).
+    ``telemetry`` (static) carries a per-replica (B, horizon,
+    NUM_METRICS) metric ring and returns it as one extra trailing
+    output."""
     n, w = dg.n, bitmask.num_words(chunk_size)
     b = origins_b.shape[0]
+    tel = tel_rings.active(telemetry)
     cov_slots = chunk_size if coverage_slots is None else coverage_slots
     cov_w = bitmask.num_words(cov_slots)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
@@ -384,31 +404,44 @@ def _run_coverage_batch(
         jnp.zeros((b, cov_slots), dtype=jnp.int32),
         jnp.zeros((b, horizon, cov_slots), dtype=jnp.int32),
     )
+    if tel:
+        state = state + (tel_rings.init_batched(b, horizon),)
 
     def cond(full_state):
-        t, _, hist, _, _, _, _ = full_state
+        t, hist = full_state[0], full_state[2]
         return (t < horizon) & (jnp.any(hist != 0) | (t <= last_gen))
 
     def step(full_state):
-        t, seen, hist, received, sent, cov_run, cov_hist = full_state
-        seen, hist, received, sent = _batched_tick(
-            dg, block, t, seen, hist, received, sent,
-            origins_b, gen_ticks_b, churn_b, slots, loss, loss_seeds_b,
-        )
+        t, seen, hist, received, sent, cov_run, cov_hist = full_state[:7]
+        if tel:
+            seen, hist, received, sent, met = _batched_tick(
+                dg, block, t, seen, hist, received, sent,
+                origins_b, gen_ticks_b, churn_b, slots, loss, loss_seeds_b,
+                telemetry_on=True,
+            )
+        else:
+            seen, hist, received, sent = _batched_tick(
+                dg, block, t, seen, hist, received, sent,
+                origins_b, gen_ticks_b, churn_b, slots, loss, loss_seeds_b,
+            )
         cov_run = cov_run + cov_delta_of(hist[:, jnp.mod(t, dg.ring_size)])
         cov_hist = jax.lax.dynamic_update_slice(
             cov_hist, cov_run[:, None, :], (0, t, 0)
         )
+        if tel:
+            return (t + 1, seen, hist, received, sent, cov_run, cov_hist,
+                    tel_rings.write_batched(full_state[7], t, met))
         return (t + 1, seen, hist, received, sent, cov_run, cov_hist)
 
-    t, seen, _, received, sent, cov_run, cov_hist = jax.lax.while_loop(
-        cond, step, state
-    )
+    out = jax.lax.while_loop(cond, step, state)
+    t, seen, _, received, sent, cov_run, cov_hist = out[:7]
     # Rows past global quiescence hold the (monotone, constant) final
     # coverage — identical to the solo engine's per-replica fill, since a
     # replica's cov_run stops changing at ITS quiescence.
     ticks = jnp.arange(horizon, dtype=jnp.int32)[None, :, None]
     coverage = jnp.where(ticks >= t, cov_run[:, None, :], cov_hist)
+    if tel:
+        return seen, received, sent, coverage, out[7]
     return seen, received, sent, coverage
 
 
@@ -418,7 +451,8 @@ def _run_coverage_batch(
     count_compiles=True,
 )
 @functools.partial(
-    jax.jit, static_argnames=("chunk_size", "horizon", "block", "loss")
+    jax.jit,
+    static_argnames=("chunk_size", "horizon", "block", "loss", "telemetry"),
 )
 def _run_while_batch(
     dg: DeviceGraph,
@@ -433,15 +467,18 @@ def _run_while_batch(
     horizon: int,
     block: int,
     loss: tuple | None = None,
+    telemetry: bool = False,
 ):
     """Counter-only replica batch (no coverage history) — the gossip-
     campaign counterpart of ``engine.sync._run_chunk_while``. The tick
     counter is global: ticks before a replica's own first generation are
     identity updates (empty frontier, no firing gens), exactly as the
-    solo engine's earlier ``t_start`` would skip them."""
+    solo engine's earlier ``t_start`` would skip them. ``telemetry`` as
+    in `_run_coverage_batch` (extra (B, horizon, M) trailing output)."""
     n, w = dg.n, bitmask.num_words(chunk_size)
     b = origins_b.shape[0]
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
+    tel = tel_rings.active(telemetry)
     state = (
         t_start,
         jnp.zeros((b, n, w), dtype=jnp.uint32),
@@ -449,20 +486,33 @@ def _run_while_batch(
         jnp.zeros((b, n), dtype=jnp.int32),
         jnp.zeros((b, n), dtype=jnp.int32),
     )
+    if tel:
+        state = state + (tel_rings.init_batched(b, horizon),)
 
     def cond(state):
-        t, _, hist, _, _ = state
+        t, hist = state[0], state[2]
         return (t < horizon) & (jnp.any(hist != 0) | (t <= last_gen))
 
     def body(state):
-        t, seen, hist, received, sent = state
+        t, seen, hist, received, sent = state[:5]
+        if tel:
+            seen, hist, received, sent, met = _batched_tick(
+                dg, block, t, seen, hist, received, sent,
+                origins_b, gen_ticks_b, churn_b, slots, loss, loss_seeds_b,
+                telemetry_on=True,
+            )
+            return (t + 1, seen, hist, received, sent,
+                    tel_rings.write_batched(state[5], t, met))
         seen, hist, received, sent = _batched_tick(
             dg, block, t, seen, hist, received, sent,
             origins_b, gen_ticks_b, churn_b, slots, loss, loss_seeds_b,
         )
         return (t + 1, seen, hist, received, sent)
 
-    _, seen, _, received, sent = jax.lax.while_loop(cond, body, state)
+    out = jax.lax.while_loop(cond, body, state)
+    _, seen, _, received, sent = out[:5]
+    if tel:
+        return seen, received, sent, out[5]
     return seen, received, sent
 
 
@@ -672,6 +722,7 @@ def run_coverage_campaign(
     )
     from p2p_gossip_tpu.utils.checkpoint import checkpointed_chunks
 
+    tel = telemetry.rings_enabled()
     batches = list(_iter_batches(replicas, batch_size, horizon, lseed_arr))
     t0 = time.perf_counter()
     for _bi, batch in checkpointed_chunks(
@@ -691,15 +742,31 @@ def run_coverage_campaign(
             None if churn_parts[0] is None else tuple(churn_parts)
         )
         lseeds_dev = None if lseeds is None else jnp.asarray(lseeds)
-        _, r, snt, cov = _run_coverage_batch(
-            dg, jnp.asarray(pad_o), jnp.asarray(pad_g), churn_dev,
-            lseeds_dev,
-            chunk_size=chunk, horizon=horizon, block=block, loss=loss_cfg,
-            coverage_slots=s,
-        )
-        received[lo : lo + live] = np.asarray(r)[:live]
-        sent[lo : lo + live] = np.asarray(snt)[:live]
-        coverage[lo : lo + live] = np.asarray(cov)[:live, :, :s]
+        with telemetry.span(
+            "dispatch", kernel="batch.campaign._run_coverage_batch",
+            batch=_bi,
+        ):
+            out = _run_coverage_batch(
+                dg, jnp.asarray(pad_o), jnp.asarray(pad_g), churn_dev,
+                lseeds_dev,
+                chunk_size=chunk, horizon=horizon, block=block, loss=loss_cfg,
+                coverage_slots=s, telemetry=tel,
+            )
+        if tel:
+            _, r, snt, cov, met = out
+        else:
+            _, r, snt, cov = out
+        with telemetry.span("d2h", batch=_bi):
+            received[lo : lo + live] = np.asarray(r)[:live]
+            sent[lo : lo + live] = np.asarray(snt)[:live]
+            coverage[lo : lo + live] = np.asarray(cov)[:live, :, :s]
+        if tel:
+            met_np = np.asarray(met)
+            for i in range(live):
+                tel_rings.emit_ring(
+                    "batch.campaign.run_coverage_campaign", met_np[i],
+                    t0=0, replica=lo + i, seed=int(replicas.seeds[lo + i]),
+                )
     wall = time.perf_counter() - t0
 
     return CampaignResult(
@@ -765,6 +832,7 @@ def run_gossip_campaign(
     )
     from p2p_gossip_tpu.utils.checkpoint import checkpointed_chunks
 
+    tel = telemetry.rings_enabled()
     batches = list(_iter_batches(replicas, batch_size, horizon, lseed_arr))
     t0 = time.perf_counter()
     for _bi, batch in checkpointed_chunks(
@@ -795,14 +863,32 @@ def run_gossip_campaign(
                 None if churn_parts[0] is None else tuple(churn_parts)
             )
             lseeds_dev = None if lseeds_s is None else jnp.asarray(lseeds_s)
-            _, r, snt = _run_while_batch(
-                dg, jnp.asarray(pad_o), jnp.asarray(pad_g),
-                jnp.asarray(t_start), jnp.asarray(last_gen), churn_dev,
-                lseeds_dev,
-                chunk_size=chunk, horizon=horizon, block=block, loss=loss_cfg,
-            )
-            received[lo : lo + live] += np.asarray(r, dtype=np.int64)[:live]
-            sent[lo : lo + live] += np.asarray(snt, dtype=np.int64)[:live]
+            with telemetry.span(
+                "dispatch", kernel="batch.campaign._run_while_batch",
+                batch=_bi, chunk=ci,
+            ):
+                out = _run_while_batch(
+                    dg, jnp.asarray(pad_o), jnp.asarray(pad_g),
+                    jnp.asarray(t_start), jnp.asarray(last_gen), churn_dev,
+                    lseeds_dev,
+                    chunk_size=chunk, horizon=horizon, block=block,
+                    loss=loss_cfg, telemetry=tel,
+                )
+            if tel:
+                _, r, snt, met = out
+            else:
+                _, r, snt = out
+            with telemetry.span("d2h", batch=_bi, chunk=ci):
+                received[lo : lo + live] += np.asarray(r, dtype=np.int64)[:live]
+                sent[lo : lo + live] += np.asarray(snt, dtype=np.int64)[:live]
+            if tel:
+                met_np = np.asarray(met)
+                for i in range(live):
+                    tel_rings.emit_ring(
+                        "batch.campaign.run_gossip_campaign", met_np[i],
+                        t0=int(t_start), chunk=ci, replica=lo + i,
+                        seed=int(replicas.seeds[lo + i]),
+                    )
     wall = time.perf_counter() - t0
 
     return CampaignResult(
@@ -821,13 +907,14 @@ def run_gossip_campaign(
 
 # --- staticcheck audit specs (p2p_gossip_tpu/staticcheck/) ----------------
 
-def _audit_spec_batch(kind: str):
+def _audit_spec_batch(kind: str, telemetry_on: bool = False):
     """Tiny replica batch for the jaxpr auditor: B=2 replicas x 48 nodes,
     one 32-share chunk — same operand structure the campaign drivers
     stage, loss seeds riding the batch axis so the traced-seed path is
     the audited one."""
     from p2p_gossip_tpu.engine.sync import _audit_inputs
     from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+    from p2p_gossip_tpu.telemetry.schema import NUM_METRICS
 
     chunk, horizon, b = 32, 16, 2
     dg, origins, gen_ticks = _audit_inputs(chunk, horizon)
@@ -835,12 +922,16 @@ def _audit_spec_batch(kind: str):
     gen_ticks_b = jnp.broadcast_to(gen_ticks, (b, chunk))
     lseeds_b = jnp.arange(b, dtype=jnp.uint32)
     common = dict(chunk_size=chunk, horizon=horizon, block=8, loss=(1 << 20, None))
+    words: tuple = (bitmask.num_words(chunk),)
+    if telemetry_on:
+        common["telemetry"] = True
+        words = words + (NUM_METRICS,)
     if kind == "coverage":
         return AuditSpec(
             args=(dg, origins_b, gen_ticks_b, None, lseeds_b),
             kwargs=dict(**common, coverage_slots=4),
             integer_only=True,
-            bitmask_words=bitmask.num_words(chunk),
+            bitmask_words=words,
         )
     return AuditSpec(
         args=(
@@ -850,8 +941,21 @@ def _audit_spec_batch(kind: str):
         ),
         kwargs=common,
         integer_only=True,
-        bitmask_words=bitmask.num_words(chunk),
+        bitmask_words=words,
     )
+
+
+# Telemetry-on variants of the batched campaign kernels.
+register_entry(
+    "batch.campaign._run_coverage_batch[telemetry]",
+    _run_coverage_batch,
+    spec=lambda: _audit_spec_batch("coverage", telemetry_on=True),
+)
+register_entry(
+    "batch.campaign._run_while_batch[telemetry]",
+    _run_while_batch,
+    spec=lambda: _audit_spec_batch("while", telemetry_on=True),
+)
 
 
 def run_protocol_campaign(
@@ -970,6 +1074,7 @@ def run_protocol_campaign(
     )
     from p2p_gossip_tpu.utils.checkpoint import checkpointed_chunks
 
+    tel = telemetry.rings_enabled()
     batches = list(_iter_batches(replicas, batch_size, horizon, lseed_arr))
     t0 = time.perf_counter()
     for _bi, batch in checkpointed_chunks(
@@ -992,27 +1097,45 @@ def run_protocol_campaign(
             churn_dev = (
                 None if churn_parts[0] is None else tuple(churn_parts)
             )
-            if protocol == "pushk":
-                _, r, (s_lo, s_hi), cov = _run_pushk_replicas(
-                    dg, jnp.asarray(pad_o), jnp.asarray(pad_g),
-                    jnp.asarray(seeds_s), jnp.asarray(lseeds_s), churn_dev,
-                    fanout=fanout, chunk_size=chunk, horizon=horizon,
-                    record_coverage=record_coverage, loss_threshold=loss_thr,
-                )
+            with telemetry.span(
+                "dispatch", kernel=f"batch.campaign.{protocol}_replicas",
+                batch=_bi, chunk=ci,
+            ):
+                if protocol == "pushk":
+                    out = _run_pushk_replicas(
+                        dg, jnp.asarray(pad_o), jnp.asarray(pad_g),
+                        jnp.asarray(seeds_s), jnp.asarray(lseeds_s), churn_dev,
+                        fanout=fanout, chunk_size=chunk, horizon=horizon,
+                        record_coverage=record_coverage,
+                        loss_threshold=loss_thr, telemetry=tel,
+                    )
+                else:
+                    out = _run_pushpull_replicas(
+                        dg, jnp.asarray(pad_o), jnp.asarray(pad_g),
+                        jnp.asarray(seeds_s), jnp.asarray(lseeds_s), churn_dev,
+                        chunk_size=chunk, horizon=horizon,
+                        record_coverage=record_coverage,
+                        loss_threshold=loss_thr, mode=protocol, telemetry=tel,
+                    )
+            if tel:
+                _, r, (s_lo, s_hi), cov, met = out
             else:
-                _, r, (s_lo, s_hi), cov = _run_pushpull_replicas(
-                    dg, jnp.asarray(pad_o), jnp.asarray(pad_g),
-                    jnp.asarray(seeds_s), jnp.asarray(lseeds_s), churn_dev,
-                    chunk_size=chunk, horizon=horizon,
-                    record_coverage=record_coverage, loss_threshold=loss_thr,
-                    mode=protocol,
-                )
-            received[lo : lo + live] += np.asarray(r, dtype=np.int64)[:live]
-            sent[lo : lo + live] += bitmask.combine_u64(s_lo, s_hi)[:live]
-            if record_coverage:
-                coverage[lo : lo + live, :, ci * chunk : ci * chunk + live_s] = (
-                    np.asarray(cov)[:live, :, :live_s]
-                )
+                _, r, (s_lo, s_hi), cov = out
+            with telemetry.span("d2h", batch=_bi, chunk=ci):
+                received[lo : lo + live] += np.asarray(r, dtype=np.int64)[:live]
+                sent[lo : lo + live] += bitmask.combine_u64(s_lo, s_hi)[:live]
+                if record_coverage:
+                    coverage[
+                        lo : lo + live, :, ci * chunk : ci * chunk + live_s
+                    ] = np.asarray(cov)[:live, :, :live_s]
+            if tel:
+                met_np = np.asarray(met)
+                for i in range(live):
+                    tel_rings.emit_ring(
+                        f"batch.campaign.run_protocol_campaign[{protocol}]",
+                        met_np[i], t0=0, ticks=horizon, chunk=ci,
+                        replica=lo + i, seed=int(replicas.seeds[lo + i]),
+                    )
     wall = time.perf_counter() - t0
 
     return CampaignResult(

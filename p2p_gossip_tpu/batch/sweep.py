@@ -49,6 +49,7 @@ from p2p_gossip_tpu.models import topology as topo
 from p2p_gossip_tpu.models.generation import Schedule
 from p2p_gossip_tpu.models.linkloss import LinkLossModel
 from p2p_gossip_tpu.models.seeds import churn_stream_seed, loss_stream_seed
+from p2p_gossip_tpu import telemetry
 from p2p_gossip_tpu.utils import logging as p2plog
 
 log = p2plog.get_logger("Batch.Sweep")
@@ -226,17 +227,22 @@ def run_cell(
         mean_down_ticks=cell["churnDowntimeTicks"],
         max_outages=cell["churnOutages"],
     )
-    if cell["protocol"] == "push":
-        result = run_coverage_campaign(
-            graph, replicas, cell["horizon"], loss=loss,
-            batch_size=batch_size, mesh=mesh,
-        )
-    else:
-        result = run_protocol_campaign(
-            graph, replicas, cell["horizon"], protocol=cell["protocol"],
-            fanout=cell["fanout"], loss=loss, batch_size=batch_size,
-            mesh=mesh,
-        )
+    with telemetry.span(
+        "cell", protocol=cell["protocol"], p=cell["p"],
+        lossProb=cell["lossProb"], churnProb=cell["churnProb"],
+        replicas=len(seeds),
+    ):
+        if cell["protocol"] == "push":
+            result = run_coverage_campaign(
+                graph, replicas, cell["horizon"], loss=loss,
+                batch_size=batch_size, mesh=mesh,
+            )
+        else:
+            result = run_protocol_campaign(
+                graph, replicas, cell["horizon"], protocol=cell["protocol"],
+                fanout=cell["fanout"], loss=loss, batch_size=batch_size,
+                mesh=mesh,
+            )
     engine = "vmap"
     wall = time.perf_counter() - t0
 
@@ -282,4 +288,5 @@ def run_sweep(
         records.append(record)
         if emit is not None:
             emit(record)
+    telemetry.emit_jit_cache_counters()
     return records

@@ -50,7 +50,9 @@ from p2p_gossip_tpu.ops.ell import (
     propagate_uniform,
     tuned_degree_block,
 )
-from p2p_gossip_tpu.staticcheck.registry import audited
+from p2p_gossip_tpu.staticcheck.registry import audited, register_entry
+from p2p_gossip_tpu import telemetry
+from p2p_gossip_tpu.telemetry import rings as tel_rings
 from p2p_gossip_tpu.utils import logging as p2plog
 from p2p_gossip_tpu.utils.stats import NodeStats
 
@@ -358,11 +360,19 @@ def apply_tick_updates(
 
 def _tick_body(
     dg: DeviceGraph, block: int, state, origins, slots, gen_ticks, churn=None,
-    loss=None, connect_tick: int = 0, loss_seed=None,
+    loss=None, connect_tick: int = 0, loss_seed=None, telemetry: bool = False,
 ):
     """One synchronous tick. state = (t, seen, hist, received, sent) ->
     state'. Coverage-recording callers derive the tick's coverage delta
     from the hist slot this tick writes (it IS the newly_out frontier).
+
+    ``telemetry`` (static) additionally returns the tick's metric-ring
+    row (telemetry/rings.py flood_row) as ``(state', row)`` — callers
+    must gate on the same `tel_rings.active` answer. When False the
+    return shape and the traced program are exactly the pre-telemetry
+    ones (the zero-cost contract staticcheck enforces); the only cost of
+    telemetry-on is the row's integer reductions plus, under a loss
+    model, a second loss-free gather that prices ``loss_dropped``.
 
     ``churn`` is an optional ``(down_start, down_end)`` pair of (N, K)
     interval arrays (models/churn.py): a down node's arrivals are lost
@@ -377,24 +387,32 @@ def _tick_body(
     """
     t, seen, hist, received, sent = state
     n, w = seen.shape
-    if dg.buckets is not None:
-        arrivals = propagate_bucketed(
-            hist, t, dg.buckets, n_out=n,
-            ring_size=dg.ring_size, uniform_delay=dg.uniform_delay, block=block,
-            loss=loss, loss_seed=loss_seed,
-        )
-    elif dg.uniform_delay is not None:
-        arrivals = propagate_uniform(
-            hist, t, dg.ell_idx, dg.ell_mask,
-            ring_size=dg.ring_size, uniform_delay=dg.uniform_delay, block=block,
-            loss=loss, loss_seed=loss_seed,
-        )
-    else:
-        arrivals = propagate(
+
+    def _gather(loss_cfg, lseed):
+        if dg.buckets is not None:
+            return propagate_bucketed(
+                hist, t, dg.buckets, n_out=n,
+                ring_size=dg.ring_size, uniform_delay=dg.uniform_delay,
+                block=block, loss=loss_cfg, loss_seed=lseed,
+            )
+        if dg.uniform_delay is not None:
+            return propagate_uniform(
+                hist, t, dg.ell_idx, dg.ell_mask,
+                ring_size=dg.ring_size, uniform_delay=dg.uniform_delay,
+                block=block, loss=loss_cfg, loss_seed=lseed,
+            )
+        return propagate(
             hist, t, dg.ell_idx, dg.ell_delay, dg.ell_mask,
-            ring_size=dg.ring_size, block=block, loss=loss,
-            loss_seed=loss_seed,
+            ring_size=dg.ring_size, block=block, loss=loss_cfg,
+            loss_seed=lseed,
         )
+
+    arrivals = _gather(loss, loss_seed)
+    tel = tel_rings.active(telemetry)
+    if tel:
+        received_in = received
+        arrivals_raw = arrivals  # post-loss, pre-churn — the wire view
+        arrivals_nl = _gather(None, None) if loss is not None else None
     gen_active = gen_ticks == t
     if churn is not None:
         up = up_mask_jnp(churn[0], churn[1], t)
@@ -424,13 +442,21 @@ def _tick_body(
             seen, arrivals, gen_bits, gen_cnt, received, sent, dg.degree,
         )
     hist = hist.at[jnp.mod(t, dg.ring_size)].set(newly_out)
+    if tel:
+        met = tel_rings.flood_row(
+            arrivals_raw, newly_out, received - received_in, dg.degree,
+            arrivals_lossless=arrivals_nl,
+        )
+        return (t + 1, seen, hist, received, sent), met
     return (t + 1, seen, hist, received, sent)
 
 
 @audited("engine.sync._run_chunk_while", spec=lambda: _audit_spec_chunk_while())
 @functools.partial(
     jax.jit,
-    static_argnames=("chunk_size", "horizon", "block", "loss", "connect_tick"),
+    static_argnames=(
+        "chunk_size", "horizon", "block", "loss", "connect_tick", "telemetry",
+    ),
 )
 def _run_chunk_while(
     dg: DeviceGraph,
@@ -446,6 +472,7 @@ def _run_chunk_while(
     block: int,
     loss: tuple | None = None,
     connect_tick: int = 0,
+    telemetry: bool = False,
 ):
     """Run one share chunk to quiescence (or the horizon) under while_loop.
 
@@ -453,10 +480,17 @@ def _run_chunk_while(
     moment the tick counter reaches each boundary — i.e. totals over all
     ticks strictly before it, matching the event engine's snapshot timing
     (PrintPeriodicStats, p2pnetwork.cc:231).
+
+    ``telemetry`` (static) carries a (horizon, NUM_METRICS) metric ring
+    through the loop and returns it as one extra trailing output — rows
+    [t_start, exit) hold per-tick aggregates, harvested by the host once
+    per chunk (telemetry/rings.py). Off by default; the disabled jaxpr
+    is byte-identical to the pre-telemetry program.
     """
     n, w = dg.n, bitmask.num_words(chunk_size)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
     k = 0 if snap_ticks is None else snap_ticks.shape[0]
+    tel = tel_rings.active(telemetry)
     state = (
         t_start,
         jnp.zeros((n, w), dtype=jnp.uint32),
@@ -465,32 +499,44 @@ def _run_chunk_while(
         jnp.zeros((n,), dtype=jnp.int32),
         jnp.zeros((k, n), dtype=jnp.int32),
     )
+    if tel:
+        state = state + (tel_rings.init(horizon),)
 
     def cond(state):
-        t, _, hist, _, _, _ = state
+        t, hist = state[0], state[2]
         in_flight = jnp.any(hist != 0)
         pending = t <= last_gen
         return (t < horizon) & (in_flight | pending)
 
     def body(state):
-        t, seen, hist, received, sent, snaps = state
+        t, seen, hist, received, sent, snaps = state[:6]
         if k:
             snaps = jnp.where(
                 (snap_ticks == t)[:, None], received[None, :], snaps
             )
+        if tel:
+            (t_n, seen, hist, received, sent), met_row = _tick_body(
+                dg, block, (t, seen, hist, received, sent), origins, slots,
+                gen_ticks, churn, loss, connect_tick, telemetry=True,
+            )
+            return (t_n, seen, hist, received, sent, snaps,
+                    tel_rings.write(state[6], t, met_row))
         t, seen, hist, received, sent = _tick_body(
             dg, block, (t, seen, hist, received, sent), origins, slots,
             gen_ticks, churn, loss, connect_tick,
         )
         return (t, seen, hist, received, sent, snaps)
 
-    t, seen, hist, received, sent, snaps = jax.lax.while_loop(cond, body, state)
+    out = jax.lax.while_loop(cond, body, state)
+    t, seen, hist, received, sent, snaps = out[:6]
     if k:
         # Boundaries at/after quiescence see the (unchanging) final counts.
         snaps = jnp.where((snap_ticks >= t)[:, None], received[None, :], snaps)
     # t - t_start = ticks actually executed (quiescence can stop well
     # before the horizon) — the roofline accounting in bench.py divides
     # measured wall time by this.
+    if tel:
+        return seen, received, sent, snaps, t - t_start, out[6]
     return seen, received, sent, snaps, t - t_start
 
 
@@ -502,7 +548,7 @@ def _run_chunk_while(
     jax.jit,
     static_argnames=(
         "chunk_size", "horizon", "block", "use_pallas", "coverage_slots",
-        "loss",
+        "loss", "telemetry",
     ),
 )
 def _run_chunk_coverage(
@@ -517,6 +563,7 @@ def _run_chunk_coverage(
     use_pallas: bool = False,
     coverage_slots: int | None = None,
     loss: tuple | None = None,
+    telemetry: bool = False,
 ):
     """Coverage-recording run from t=0 — drives the time-to-coverage
     metrics. Returns per-tick coverage (horizon, S) but exits the tick loop
@@ -532,8 +579,10 @@ def _run_chunk_coverage(
     ``use_pallas`` selects the one-pass coverage kernel for the delta
     reduction on TPU. ``coverage_slots`` limits the recorded coverage to
     the first S slots (the live shares) — the chunk itself may be
-    lane-padded far wider (MIN_CHUNK_SHARES)."""
+    lane-padded far wider (MIN_CHUNK_SHARES). ``telemetry`` as in
+    `_run_chunk_while` (one extra trailing metric-ring output)."""
     n, w = dg.n, bitmask.num_words(chunk_size)
+    tel = tel_rings.active(telemetry)
     cov_slots = chunk_size if coverage_slots is None else coverage_slots
     cov_w = bitmask.num_words(cov_slots)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
@@ -556,17 +605,25 @@ def _run_chunk_coverage(
         jnp.zeros((cov_slots,), dtype=jnp.int32),   # running coverage
         jnp.zeros((horizon, cov_slots), dtype=jnp.int32),
     )
+    if tel:
+        state = state + (tel_rings.init(horizon),)
 
     def cond(full_state):
-        t, _, hist, _, _, _, _ = full_state
+        t, hist = full_state[0], full_state[2]
         return (t < horizon) & (jnp.any(hist != 0) | (t <= last_gen))
 
     def step(full_state):
-        t, seen, hist, received, sent, cov_run, cov_hist = full_state
-        new_state = _tick_body(
-            dg, block, (t, seen, hist, received, sent), origins, slots,
-            gen_ticks, churn, loss,
-        )
+        t, seen, hist, received, sent, cov_run, cov_hist = full_state[:7]
+        if tel:
+            new_state, met_row = _tick_body(
+                dg, block, (t, seen, hist, received, sent), origins, slots,
+                gen_ticks, churn, loss, telemetry=True,
+            )
+        else:
+            new_state = _tick_body(
+                dg, block, (t, seen, hist, received, sent), origins, slots,
+                gen_ticks, churn, loss,
+            )
         # hist slot (t mod D) was written by this tick: it IS the
         # newly_out frontier.
         cov_delta = cov_delta_of(new_state[2][jnp.mod(t, dg.ring_size)])
@@ -574,14 +631,18 @@ def _run_chunk_coverage(
         cov_hist = jax.lax.dynamic_update_slice(
             cov_hist, cov_run[None], (t, 0)
         )
+        if tel:
+            return (*new_state, cov_run, cov_hist,
+                    tel_rings.write(full_state[7], t, met_row))
         return (*new_state, cov_run, cov_hist)
 
-    t, seen, _, received, sent, cov_run, cov_hist = jax.lax.while_loop(
-        cond, step, state
-    )
+    out = jax.lax.while_loop(cond, step, state)
+    t, seen, _, received, sent, cov_run, cov_hist = out[:7]
     # Rows past quiescence hold the (monotone, now constant) final coverage.
     ticks = jnp.arange(horizon, dtype=jnp.int32)[:, None]
     coverage = jnp.where(ticks >= t, cov_run[None, :], cov_hist)
+    if tel:
+        return seen, received, sent, coverage, out[7]
     return seen, received, sent, coverage
 
 
@@ -687,6 +748,7 @@ def run_sync_sim(
 
     from p2p_gossip_tpu.utils.checkpoint import checkpointed_chunks
 
+    tel = telemetry.rings_enabled()
     chunks = schedule.chunk(chunk_size)
     for ci, chunk in checkpointed_chunks(chunks, checkpointer, stop_after_chunks):
         live = chunk.gen_ticks < horizon_ticks
@@ -701,17 +763,30 @@ def run_sync_sim(
                 )
             t_start = jnp.asarray(first_t, dtype=jnp.int32)
             last_gen = jnp.asarray(last_t, dtype=jnp.int32)
-            _, r, s, snaps, t_run = _run_chunk_while(
-                dg, jnp.asarray(origins), jnp.asarray(gen_ticks), t_start,
-                last_gen, churn_dev, snap_ticks_dev,
-                chunk_size=chunk_size, horizon=horizon_ticks, block=block,
-                loss=loss_cfg, connect_tick=connect_tick,
-            )
-            received += np.asarray(r, dtype=np.int64)
-            sent += np.asarray(s, dtype=np.int64)
-            ticks_executed += int(t_run)
-            if boundaries:
-                snap_received += np.asarray(snaps, dtype=np.int64)
+            with telemetry.span(
+                "dispatch", kernel="engine.sync._run_chunk_while", chunk=ci
+            ):
+                out = _run_chunk_while(
+                    dg, jnp.asarray(origins), jnp.asarray(gen_ticks), t_start,
+                    last_gen, churn_dev, snap_ticks_dev,
+                    chunk_size=chunk_size, horizon=horizon_ticks, block=block,
+                    loss=loss_cfg, connect_tick=connect_tick, telemetry=tel,
+                )
+            if tel:
+                _, r, s, snaps, t_run, met = out
+            else:
+                _, r, s, snaps, t_run = out
+            with telemetry.span("d2h", chunk=ci):
+                received += np.asarray(r, dtype=np.int64)
+                sent += np.asarray(s, dtype=np.int64)
+                ticks_executed += int(t_run)
+                if boundaries:
+                    snap_received += np.asarray(snaps, dtype=np.int64)
+            if tel:
+                tel_rings.emit_ring(
+                    "engine.sync.run_sync_sim", np.asarray(met),
+                    t0=first_t, ticks=int(t_run), chunk=ci,
+                )
 
     generated = effective_generated(schedule, horizon_ticks, churn)
     degree = np.asarray(dg.degree, dtype=np.int64)
@@ -790,11 +865,23 @@ def run_flood_coverage(
         log.info(f"coverage: Pallas kernel on the XLA path ({reason})")
     churn_dev = churn_to_device(churn)
     loss_cfg = loss.static_cfg if loss is not None else None
-    _, r, snt, cov = _run_chunk_coverage(
-        dg, jnp.asarray(o), jnp.asarray(g), churn_dev,
-        chunk_size=chunk_size, horizon=horizon_ticks, block=block,
-        use_pallas=use_pallas, coverage_slots=s, loss=loss_cfg,
-    )
+    tel = telemetry.rings_enabled()
+    with telemetry.span(
+        "dispatch", kernel="engine.sync._run_chunk_coverage"
+    ):
+        out = _run_chunk_coverage(
+            dg, jnp.asarray(o), jnp.asarray(g), churn_dev,
+            chunk_size=chunk_size, horizon=horizon_ticks, block=block,
+            use_pallas=use_pallas, coverage_slots=s, loss=loss_cfg,
+            telemetry=tel,
+        )
+    if tel:
+        _, r, snt, cov, met = out
+        tel_rings.emit_ring(
+            "engine.sync.run_flood_coverage", np.asarray(met), t0=0,
+        )
+    else:
+        _, r, snt, cov = out
     generated = effective_generated(sched, horizon_ticks, churn)
     received = np.asarray(r, dtype=np.int64)
     stats = NodeStats(
@@ -829,35 +916,64 @@ def _audit_inputs(chunk: int = 32, horizon: int = 16):
     return dg, jnp.asarray(origins), jnp.asarray(gen_ticks)
 
 
-def _audit_spec_chunk_while():
+def _audit_spec_chunk_while(telemetry: bool = False):
     from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+    from p2p_gossip_tpu.telemetry.schema import NUM_METRICS
 
     chunk, horizon = 32, 16
     dg, origins, gen_ticks = _audit_inputs(chunk, horizon)
+    kwargs = dict(chunk_size=chunk, horizon=horizon, block=8)
+    words: tuple | int = bitmask.num_words(chunk)
+    if telemetry:
+        # The metric ring rides the signature as a (horizon, M) uint32
+        # output — its minor axis is a declared width, not a leak.
+        kwargs["telemetry"] = True
+        words = (words, NUM_METRICS)
     return AuditSpec(
         args=(
             dg, origins, gen_ticks,
             jnp.asarray(0, dtype=jnp.int32), jnp.asarray(2, dtype=jnp.int32),
         ),
-        kwargs=dict(chunk_size=chunk, horizon=horizon, block=8),
+        kwargs=kwargs,
         integer_only=True,
-        bitmask_words=bitmask.num_words(chunk),
+        bitmask_words=words,
     )
 
 
-def _audit_spec_chunk_coverage():
+def _audit_spec_chunk_coverage(telemetry: bool = False):
     from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+    from p2p_gossip_tpu.telemetry.schema import NUM_METRICS
 
     chunk, horizon = 32, 16
     dg, origins, gen_ticks = _audit_inputs(chunk, horizon)
+    kwargs = dict(
+        chunk_size=chunk, horizon=horizon, block=8, coverage_slots=4,
+    )
+    words: tuple | int = bitmask.num_words(chunk)
+    if telemetry:
+        kwargs["telemetry"] = True
+        words = (words, NUM_METRICS)
     return AuditSpec(
         args=(dg, origins, gen_ticks),
-        kwargs=dict(
-            chunk_size=chunk, horizon=horizon, block=8, coverage_slots=4,
-        ),
+        kwargs=kwargs,
         integer_only=True,
-        bitmask_words=bitmask.num_words(chunk),
+        bitmask_words=words,
     )
+
+
+# Telemetry-on variants of the chunk kernels: same callables, audited
+# with the metric ring threaded — the instrumented surfaces are first-
+# class registry entries, not a blind spot (satellite of ISSUE 4).
+register_entry(
+    "engine.sync._run_chunk_while[telemetry]",
+    _run_chunk_while,
+    spec=lambda: _audit_spec_chunk_while(telemetry=True),
+)
+register_entry(
+    "engine.sync._run_chunk_coverage[telemetry]",
+    _run_chunk_coverage,
+    spec=lambda: _audit_spec_chunk_coverage(telemetry=True),
+)
 
 
 def time_to_coverage(coverage: np.ndarray, n: int, fraction: float = 0.99):

@@ -41,7 +41,9 @@ from p2p_gossip_tpu.models.partnersel import pick_index_jnp
 from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
 from p2p_gossip_tpu.ops.segment import scatter_or_auto
-from p2p_gossip_tpu.staticcheck.registry import audited
+from p2p_gossip_tpu.staticcheck.registry import audited, register_entry
+from p2p_gossip_tpu import telemetry
+from p2p_gossip_tpu.telemetry import rings as tel_rings
 from p2p_gossip_tpu.utils.stats import NodeStats
 
 
@@ -70,6 +72,7 @@ def _pushpull_scan(
     record_coverage: bool = False,
     loss: tuple | None = None,
     mode: str = "pushpull",           # "pushpull" | "pull"
+    telemetry: bool = False,
 ):
     """The one round loop behind both execution forms: the solo jit
     (`_run_pushpull`, static loss seed) and the campaign's replica vmap
@@ -78,11 +81,17 @@ def _pushpull_scan(
     exact computation — all ops are integer/bitwise and the argsort
     inside `scatter_or` is stable, so adding a batch axis changes no
     element. ``loss`` is (static threshold, seed) where the seed may be a
-    traced uint32 scalar (models/linkloss.py)."""
+    traced uint32 scalar (models/linkloss.py).
+
+    ``telemetry`` (static) stacks one metric-ring row per round as an
+    extra trailing (horizon, NUM_METRICS) output (telemetry/rings.py) —
+    the scan's ``ys`` stacking is the ring. Off by default; disabled
+    traces are byte-identical to the pre-telemetry program."""
     n, w = dg.n, bitmask.num_words(chunk_size)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
     ring = dg.ring_size
     use_override = partners_override.ndim == 2
+    tel = tel_rings.active(telemetry)
 
     state = (
         jnp.zeros((n, w), dtype=jnp.uint32),          # seen
@@ -167,6 +176,34 @@ def _pushpull_scan(
                 attempted, bitmask.popcount_rows(my_old), 0
             )
         sent_lo, sent_hi = bitmask.add_u64(sent_lo, sent_hi, sent_add)
+        if tel:
+            # New seen-universe bits this round (dedup'ed, incl. gens).
+            newbits = (incoming | gen_bits) & ~seen
+            pc_newbits = bitmask.popcount_rows(newbits)
+            if loss is None:
+                dropped = jnp.uint32(0)
+            else:
+                # Bits lost in flight, per attempted transmission: the
+                # pull payload the coin erased, plus (push-pull only)
+                # the pushed digests that never landed.
+                dropped = tel_rings.u32sum(
+                    jnp.where(attempted & ~pull_ok, pc_remote, 0)
+                )
+                if mode != "pull":
+                    dropped = dropped + tel_rings.u32sum(
+                        jnp.where(
+                            attempted & ~push_ok,
+                            bitmask.popcount_rows(my_old), 0,
+                        )
+                    )
+            met = tel_rings.row(
+                frontier_bits=tel_rings.u32sum(pc_newbits),
+                frontier_nodes=tel_rings.u32sum(pc_newbits > 0),
+                newly_infected=tel_rings.u32sum(newly_cnt),
+                msgs_gathered=tel_rings.total_bits(remote | pushed),
+                or_work=tel_rings.u32sum(sent_add),
+                loss_dropped=dropped,
+            )
         seen = seen | incoming | gen_bits
         received = received + newly_cnt
         hist = hist.at[jnp.mod(t, ring)].set(seen)
@@ -175,13 +212,18 @@ def _pushpull_scan(
             if record_coverage
             else jnp.zeros((0,), jnp.int32)  # nothing stacked when unused
         )
+        if tel:
+            return (seen, hist, received, sent_lo, sent_hi), (cov, met)
         return (seen, hist, received, sent_lo, sent_hi), cov
 
-    state, coverage = jax.lax.scan(
+    state, ys = jax.lax.scan(
         step, state, jnp.arange(horizon, dtype=jnp.int32)
     )
     seen, _, received, sent_lo, sent_hi = state
-    return seen, received, (sent_lo, sent_hi), coverage
+    if tel:
+        coverage, met = ys
+        return seen, received, (sent_lo, sent_hi), coverage, met
+    return seen, received, (sent_lo, sent_hi), ys
 
 
 @audited(
@@ -190,7 +232,10 @@ def _pushpull_scan(
 )
 @functools.partial(
     jax.jit,
-    static_argnames=("chunk_size", "horizon", "record_coverage", "loss", "mode"),
+    static_argnames=(
+        "chunk_size", "horizon", "record_coverage", "loss", "mode",
+        "telemetry",
+    ),
 )
 def _run_pushpull(
     dg: DeviceGraph,
@@ -205,6 +250,7 @@ def _run_pushpull(
     record_coverage: bool = False,
     loss: tuple | None = None,
     mode: str = "pushpull",
+    telemetry: bool = False,
 ):
     """Solo jit of `_pushpull_scan` — the static-loss-seed path the chunk
     driver (`_run_partnered_sim`) calls; kept bitwise-stable while the
@@ -213,6 +259,7 @@ def _run_pushpull(
         dg, origins, gen_ticks, seed, partners_override, churn,
         chunk_size=chunk_size, horizon=horizon,
         record_coverage=record_coverage, loss=loss, mode=mode,
+        telemetry=telemetry,
     )
 
 
@@ -225,6 +272,7 @@ def _run_pushpull(
     jax.jit,
     static_argnames=(
         "chunk_size", "horizon", "record_coverage", "loss_threshold", "mode",
+        "telemetry",
     ),
 )
 def _run_pushpull_replicas(
@@ -240,6 +288,7 @@ def _run_pushpull_replicas(
     record_coverage: bool = False,
     loss_threshold: int = 0,    # 0 = loss off (loss_seeds_b then unused)
     mode: str = "pushpull",
+    telemetry: bool = False,
 ):
     """Replica batch of the anti-entropy round loop: ``vmap`` of
     `_pushpull_scan` over (schedule, partner seed, loss seed, churn).
@@ -247,7 +296,9 @@ def _run_pushpull_replicas(
     shared static config while the loss seed rides the batch axis, so
     each replica draws an independent erasure stream. The scan (fixed
     trip count) batches cleanly — none of the batched-while select
-    overhead the flood campaign avoids in `batch/campaign.py`."""
+    overhead the flood campaign avoids in `batch/campaign.py`.
+    ``telemetry`` stacks a (B, horizon, NUM_METRICS) per-replica metric
+    ring as one extra trailing output."""
     override = jnp.zeros((0,), dtype=jnp.int32)
 
     def one(origins, gen_ticks, seed, lseed, churn):
@@ -256,6 +307,7 @@ def _run_pushpull_replicas(
             dg, origins, gen_ticks, seed, override, churn,
             chunk_size=chunk_size, horizon=horizon,
             record_coverage=record_coverage, loss=loss, mode=mode,
+            telemetry=telemetry,
         )
 
     if churn_b is None:
@@ -433,26 +485,42 @@ def _run_partnered_sim(
         {"received": received, "sent": sent},
     )
 
+    tel = telemetry.rings_enabled()
+    protocol_name = str(fingerprint_extra[0])
     cov_chunks = []
     chunks = schedule.chunk(chunk_size) or [schedule]
     for ci, chunk in checkpointed_chunks(chunks, checkpointer, stop_after_chunks):
         origins, gen_ticks = chunk.padded(chunk_size, horizon_ticks)
-        _, r, (s_lo, s_hi), coverage = kernel(
-            dg,
-            jnp.asarray(origins),
-            jnp.asarray(gen_ticks),
-            seed_dev,
-            override,
-            churn_dev,
-            chunk_size=chunk_size,
-            horizon=horizon_ticks,
-            record_coverage=record_coverage,
-            loss=loss_cfg,
-        )
-        received += np.asarray(r, dtype=np.int64)
-        sent += bitmask.combine_u64(s_lo, s_hi)
-        if record_coverage:
-            cov_chunks.append(np.asarray(coverage)[:, : chunk.num_shares])
+        with telemetry.span(
+            "dispatch", kernel=f"models.protocols.{protocol_name}", chunk=ci
+        ):
+            out = kernel(
+                dg,
+                jnp.asarray(origins),
+                jnp.asarray(gen_ticks),
+                seed_dev,
+                override,
+                churn_dev,
+                chunk_size=chunk_size,
+                horizon=horizon_ticks,
+                record_coverage=record_coverage,
+                loss=loss_cfg,
+                telemetry=tel,
+            )
+        if tel:
+            _, r, (s_lo, s_hi), coverage, met = out
+        else:
+            _, r, (s_lo, s_hi), coverage = out
+        with telemetry.span("d2h", chunk=ci):
+            received += np.asarray(r, dtype=np.int64)
+            sent += bitmask.combine_u64(s_lo, s_hi)
+            if record_coverage:
+                cov_chunks.append(np.asarray(coverage)[:, : chunk.num_shares])
+        if tel:
+            tel_rings.emit_ring(
+                f"models.protocols.{protocol_name}", np.asarray(met),
+                t0=0, ticks=horizon_ticks, chunk=ci,
+            )
 
     generated = effective_generated(schedule, horizon_ticks, churn)
     stats = NodeStats(
@@ -593,15 +661,17 @@ def _pushk_scan(
     horizon: int,
     record_coverage: bool = False,
     loss: tuple | None = None,
+    telemetry: bool = False,
 ):
     """Fanout-push round loop shared by the solo jit (`_run_pushk`) and
     the campaign replica vmap (`_run_pushk_replicas`) — same
-    batch-safety contract as `_pushpull_scan`."""
+    batch-safety (and ``telemetry``) contract as `_pushpull_scan`."""
     n, w = dg.n, bitmask.num_words(chunk_size)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
     ring = dg.ring_size
     use_override = partners_override.ndim == 3
     rows = jnp.arange(n, dtype=jnp.int32)
+    tel = tel_rings.active(telemetry)
 
     state = (
         jnp.zeros((n, w), dtype=jnp.uint32),          # seen
@@ -654,16 +724,33 @@ def _pushk_scan(
         pick_cnt = bitmask.popcount_rows(
             payload.reshape(n * fanout, w)
         ).reshape(n, fanout)
-        sent_lo, sent_hi = bitmask.add_u64(
-            sent_lo, sent_hi,
-            jnp.sum(jnp.where(attempted, pick_cnt, 0), axis=1),
-        )
+        sent_add = jnp.sum(jnp.where(attempted, pick_cnt, 0), axis=1)
+        sent_lo, sent_hi = bitmask.add_u64(sent_lo, sent_hi, sent_add)
         gen_active = gen_ticks == t
         if churn is not None:
             gen_active = gen_active & up[origins]
         gen_bits = bitmask.slot_scatter(n, w, origins, slots, gen_active)
         newly = incoming & ~seen
-        received = received + bitmask.popcount_rows(newly)
+        newly_cnt = bitmask.popcount_rows(newly)
+        if tel:
+            newbits = (incoming | gen_bits) & ~seen
+            pc_newbits = bitmask.popcount_rows(newbits)
+            dropped = (
+                jnp.uint32(0)
+                if loss is None
+                else tel_rings.u32sum(
+                    jnp.where(attempted & ~push_ok, pick_cnt, 0)
+                )
+            )
+            met = tel_rings.row(
+                frontier_bits=tel_rings.u32sum(pc_newbits),
+                frontier_nodes=tel_rings.u32sum(pc_newbits > 0),
+                newly_infected=tel_rings.u32sum(newly_cnt),
+                msgs_gathered=tel_rings.total_bits(incoming),
+                or_work=tel_rings.u32sum(sent_add),
+                loss_dropped=dropped,
+            )
+        received = received + newly_cnt
         seen = seen | newly | gen_bits
         hist = hist.at[jnp.mod(t, ring)].set(newly | gen_bits)
         cov = (
@@ -671,13 +758,18 @@ def _pushk_scan(
             if record_coverage
             else jnp.zeros((0,), jnp.int32)
         )
+        if tel:
+            return (seen, hist, received, sent_lo, sent_hi), (cov, met)
         return (seen, hist, received, sent_lo, sent_hi), cov
 
-    state, coverage = jax.lax.scan(
+    state, ys = jax.lax.scan(
         step, state, jnp.arange(horizon, dtype=jnp.int32)
     )
     seen, _, received, sent_lo, sent_hi = state
-    return seen, received, (sent_lo, sent_hi), coverage
+    if tel:
+        coverage, met = ys
+        return seen, received, (sent_lo, sent_hi), coverage, met
+    return seen, received, (sent_lo, sent_hi), ys
 
 
 @audited(
@@ -685,7 +777,10 @@ def _pushk_scan(
 )
 @functools.partial(
     jax.jit,
-    static_argnames=("fanout", "chunk_size", "horizon", "record_coverage", "loss"),
+    static_argnames=(
+        "fanout", "chunk_size", "horizon", "record_coverage", "loss",
+        "telemetry",
+    ),
 )
 def _run_pushk(
     dg: DeviceGraph,
@@ -700,12 +795,13 @@ def _run_pushk(
     horizon: int,
     record_coverage: bool = False,
     loss: tuple | None = None,
+    telemetry: bool = False,
 ):
     """Solo jit of `_pushk_scan` (static loss seed) — see `_run_pushpull`."""
     return _pushk_scan(
         dg, origins, gen_ticks, seed, partners_override, churn,
         fanout=fanout, chunk_size=chunk_size, horizon=horizon,
-        record_coverage=record_coverage, loss=loss,
+        record_coverage=record_coverage, loss=loss, telemetry=telemetry,
     )
 
 
@@ -718,6 +814,7 @@ def _run_pushk(
     jax.jit,
     static_argnames=(
         "fanout", "chunk_size", "horizon", "record_coverage", "loss_threshold",
+        "telemetry",
     ),
 )
 def _run_pushk_replicas(
@@ -733,9 +830,10 @@ def _run_pushk_replicas(
     horizon: int,
     record_coverage: bool = False,
     loss_threshold: int = 0,
+    telemetry: bool = False,
 ):
     """Replica batch of fanout push — the pushk leg of
-    `_run_pushpull_replicas`'s contract."""
+    `_run_pushpull_replicas`'s contract (incl. ``telemetry``)."""
     override = jnp.zeros((0,), dtype=jnp.int32)
 
     def one(origins, gen_ticks, seed, lseed, churn):
@@ -743,7 +841,7 @@ def _run_pushk_replicas(
         return _pushk_scan(
             dg, origins, gen_ticks, seed, override, churn,
             fanout=fanout, chunk_size=chunk_size, horizon=horizon,
-            record_coverage=record_coverage, loss=loss,
+            record_coverage=record_coverage, loss=loss, telemetry=telemetry,
         )
 
     if churn_b is None:
@@ -824,8 +922,9 @@ def _audit_inputs_partnered(chunk: int = 32, horizon: int = 8):
     return dg, jnp.asarray(origins), jnp.asarray(gen_ticks)
 
 
-def _audit_spec_solo(protocol: str):
+def _audit_spec_solo(protocol: str, telemetry: bool = False):
     from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+    from p2p_gossip_tpu.telemetry.schema import NUM_METRICS
 
     chunk, horizon = 32, 8
     dg, origins, gen_ticks = _audit_inputs_partnered(chunk, horizon)
@@ -838,16 +937,21 @@ def _audit_spec_solo(protocol: str):
         kwargs["fanout"] = 2
     else:
         kwargs["mode"] = protocol
+    words: tuple = (bitmask.num_words(chunk),)
+    if telemetry:
+        kwargs["telemetry"] = True
+        words = words + (NUM_METRICS,)
     return AuditSpec(
         args=(dg, origins, gen_ticks, jnp.uint32(42), override),
         kwargs=kwargs,
         integer_only=True,
-        bitmask_words=bitmask.num_words(chunk),
+        bitmask_words=words,
     )
 
 
-def _audit_spec_replicas(protocol: str):
+def _audit_spec_replicas(protocol: str, telemetry: bool = False):
     from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+    from p2p_gossip_tpu.telemetry.schema import NUM_METRICS
 
     chunk, horizon, b = 32, 8, 2
     dg, origins, gen_ticks = _audit_inputs_partnered(chunk, horizon)
@@ -863,14 +967,42 @@ def _audit_spec_replicas(protocol: str):
         kwargs["fanout"] = 2
     else:
         kwargs["mode"] = protocol
+    # The u64 ``sent`` counter halves come back as (B, N) uint32 —
+    # the node axis is a legal uint32 minor dim alongside the words.
+    words: tuple = (bitmask.num_words(chunk), dg.n)
+    if telemetry:
+        kwargs["telemetry"] = True
+        words = words + (NUM_METRICS,)
     return AuditSpec(
         args=(dg, origins_b, gen_ticks_b, seeds_b, lseeds_b),
         kwargs=kwargs,
         integer_only=True,
-        # The u64 ``sent`` counter halves come back as (B, N) uint32 —
-        # the node axis is a legal uint32 minor dim alongside the words.
-        bitmask_words=(bitmask.num_words(chunk), dg.n),
+        bitmask_words=words,
     )
+
+
+# Telemetry-on variants — the instrumented surfaces audit (and compile,
+# under --compile) like every other registered entry.
+register_entry(
+    "models.protocols._run_pushpull[telemetry]",
+    _run_pushpull,
+    spec=lambda: _audit_spec_solo("pushpull", telemetry=True),
+)
+register_entry(
+    "models.protocols._run_pushk[telemetry]",
+    _run_pushk,
+    spec=lambda: _audit_spec_solo("pushk", telemetry=True),
+)
+register_entry(
+    "models.protocols._run_pushpull_replicas[telemetry]",
+    _run_pushpull_replicas,
+    spec=lambda: _audit_spec_replicas("pushpull", telemetry=True),
+)
+register_entry(
+    "models.protocols._run_pushk_replicas[telemetry]",
+    _run_pushk_replicas,
+    spec=lambda: _audit_spec_replicas("pushk", telemetry=True),
+)
 
 
 def pushk_oracle(

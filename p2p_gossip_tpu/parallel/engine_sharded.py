@@ -64,6 +64,8 @@ from p2p_gossip_tpu.ops.ell import (
     tuned_degree_block,
 )
 from p2p_gossip_tpu.parallel.mesh import NODES_AXIS, SHARES_AXIS, pad_to_multiple
+from p2p_gossip_tpu import telemetry
+from p2p_gossip_tpu.telemetry import rings as tel_rings
 from p2p_gossip_tpu.utils.stats import NodeStats
 
 
@@ -338,6 +340,7 @@ def build_sharded_runner(
     delay_values: tuple | None = None,
     connect_tick: int = 0,
     bucket_counts: tuple = (1,),
+    telemetry_on: bool = False,
 ):
     """Compile the per-pass runner: each shares-shard processes its own
     ``chunk_size`` shares over the row-sharded graph, from the chunk's first
@@ -360,11 +363,19 @@ def build_sharded_runner(
     live slots so dead padding isn't counted every tick) — node counts
     psum'ed over the nodes axis each tick, rows past quiescence holding
     the final (constant) coverage, exactly like the sync engine's
-    coverage runs."""
+    coverage runs.
+
+    ``telemetry_on`` (static, part of the memoized signature) carries a
+    (horizon, NUM_METRICS) metric ring through the loop — per-tick rows
+    psum'ed over the nodes axis only, so each shares-shard's ring covers
+    ITS share chunk (the host emits one ring event per shard, matching
+    the solo engine's one-event-per-chunk convention) — returned stacked
+    per share-shard as one extra trailing output."""
     n_share_shards = mesh.shape[SHARES_AXIS]
     n_node_shards = mesh.shape[NODES_AXIS]
     n_loc = n_padded // n_node_shards
     w = bitmask.num_words(chunk_size)
+    tel = tel_rings.active(telemetry_on)
     if cov_slots is None:
         cov_slots = chunk_size
     cov_w = bitmask.num_words(cov_slots)
@@ -407,6 +418,8 @@ def build_sharded_runner(
                 dtype=jnp.int32,
             ),                                                    # coverage
         )
+        if tel:
+            state = state + (tel_rings.init(horizon),)            # metrics
 
         def cond(state):
             t, _, hist = state[0], state[1], state[2]
@@ -428,7 +441,7 @@ def build_sharded_runner(
                 sl = lax.all_gather(sl, NODES_AXIS, axis=0, tiled=True)
             return sl
 
-        def arrivals_for(hist, t):
+        def arrivals_for(hist, t, loss_cfg=loss):
             # One gather group per delay value (one group total under a
             # uniform delay); read_slice resolves local vs all_gathered
             # per ring layout. Within a group, the degree buckets
@@ -438,6 +451,9 @@ def build_sharded_runner(
             # (padding rows carry id n_loc and fall out). Groups OR
             # together: the delay-split ELLs partition the edge set, so
             # the OR over groups equals the full-ELL gather.
+            # ``loss_cfg`` defaults to the compiled loss model; the
+            # telemetry row prices loss_dropped by re-gathering with
+            # loss_cfg=None (telemetry-on only).
             group_delays = (
                 (uniform_delay,) if uniform_delay is not None
                 else delay_values
@@ -446,7 +462,7 @@ def build_sharded_runner(
                 # THE global-id convention the loss coin hashes (shared
                 # with the single-device engines): shard row offset +
                 # local row id. One definition for both gather branches.
-                if loss is None:
+                if loss_cfg is None:
                     return None
                 return row_offset + local_rows
 
@@ -463,7 +479,7 @@ def build_sharded_runner(
                     acc = acc | gather_or_frontier(
                         sl, t, idx_g, msk_g,
                         block=max(1, min(block, idx_g.shape[1])),
-                        loss=loss,
+                        loss=loss_cfg,
                         dst_ids=loss_dst_ids(
                             jnp.arange(n_loc, dtype=jnp.int32)
                         ),
@@ -478,7 +494,7 @@ def build_sharded_runner(
                     part = gather_or_frontier(
                         sl, t, idx_b, msk_b,
                         block=max(1, min(block, idx_b.shape[1])),
-                        loss=loss,
+                        loss=loss_cfg,
                         dst_ids=loss_dst_ids(rows_b),
                     )
                     cat_rows.append(rows_b)
@@ -492,12 +508,18 @@ def build_sharded_runner(
             return acc
 
         def body(state):
-            t, seen, hist, received, sent, snaps, cov_run, cov_hist = state
+            t, seen, hist, received, sent, snaps, cov_run, cov_hist = state[:8]
             if num_snaps:
                 snaps = jnp.where(
                     (snap_ticks == t)[:, None], received[None, :], snaps
                 )
             arrivals = arrivals_for(hist, t)
+            if tel:
+                received_in = received
+                arrivals_raw = arrivals  # post-loss, pre-churn wire view
+                arrivals_nl = (
+                    arrivals_for(hist, t, None) if loss is not None else None
+                )
             up = up_mask_jnp(churn_start, churn_end, t)
             arrivals = jnp.where(up[:, None], arrivals, jnp.uint32(0))
             local_rows = origins - row_offset
@@ -550,11 +572,22 @@ def build_sharded_runner(
                 cov_hist = lax.dynamic_update_slice(
                     cov_hist, cov_run[None], (t, 0)
                 )
-            return (t + 1, seen, hist, received, sent, snaps, cov_run, cov_hist)
+            out = (t + 1, seen, hist, received, sent, snaps, cov_run, cov_hist)
+            if tel:
+                # Local row, psum'ed over node shards only: this shard's
+                # ring describes its own share chunk system-wide.
+                met_row = lax.psum(
+                    tel_rings.flood_row(
+                        arrivals_raw, newly_out, received - received_in,
+                        degree, arrivals_lossless=arrivals_nl,
+                    ),
+                    NODES_AXIS,
+                )
+                out = out + (tel_rings.write(state[8], t, met_row),)
+            return out
 
-        t, seen, _, received, sent, snaps, cov_run, cov_hist = lax.while_loop(
-            cond, body, state
-        )
+        loop_out = lax.while_loop(cond, body, state)
+        t, seen, _, received, sent, snaps, cov_run, cov_hist = loop_out[:8]
         if record_coverage:
             # Rows past quiescence hold the (monotone, now constant) final
             # coverage — same convention as the sync engine.
@@ -568,6 +601,10 @@ def build_sharded_runner(
         received = lax.psum(received, SHARES_AXIS)
         sent = lax.psum(sent, SHARES_AXIS)
         snaps = lax.psum(snaps, SHARES_AXIS)
+        if tel:
+            # Stack per share-shard: each shard's ring is its chunk's
+            # telemetry (the host emits one event per shard).
+            return received, sent, snaps, cov_hist, loop_out[8][None]
         return received, sent, snaps, cov_hist
 
     # Per bucket triple: rows (S, R) + idx/mask (S, R, C), all with the
@@ -600,7 +637,8 @@ def build_sharded_runner(
         out_specs=(
             P(NODES_AXIS), P(NODES_AXIS), P(None, NODES_AXIS),
             P(None, SHARES_AXIS),
-        ),
+        )
+        + ((P(SHARES_AXIS, None, None),) if tel else ()),
         check_vma=False,
     )
     return jax.jit(mapped), n_share_shards * chunk_size
@@ -619,12 +657,13 @@ def _audit_mesh():
     return make_mesh(shards, shards, devices=devices[: shards * shards]), shards
 
 
-def _audit_spec_flood_runner():
+def _audit_spec_flood_runner(telemetry_on: bool = False):
     """Stage + compile-build the sharded flood runner on tiny shapes and
     hand the auditor the exact mapped callable the production driver
     runs (shard_map + jit), uniform delay, sharded ring."""
     from p2p_gossip_tpu.models.topology import erdos_renyi
     from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+    from p2p_gossip_tpu.telemetry.schema import NUM_METRICS
 
     mesh, _ = _audit_mesh()
     graph = erdos_renyi(16, 0.3, seed=0)
@@ -641,11 +680,14 @@ def _audit_spec_flood_runner():
     runner, pass_size = build_sharded_runner(
         mesh, n_padded, ring, chunk, horizon, block, uniform, 0, None,
         ring_mode=ring_mode, delay_values=delay_values,
-        bucket_counts=bucket_counts,
+        bucket_counts=bucket_counts, telemetry_on=telemetry_on,
     )
     origins = np.zeros(pass_size, dtype=np.int32)
     gen_ticks = np.full(pass_size, horizon, dtype=np.int32)
     gen_ticks[:2] = 0
+    words: tuple = (bitmask.num_words(chunk),)
+    if telemetry_on:
+        words = words + (NUM_METRICS,)
     return AuditSpec(
         fn=runner,
         args=(
@@ -653,7 +695,7 @@ def _audit_spec_flood_runner():
             np.int32(0), np.int32(0), np.zeros((0,), dtype=np.int32),
         ),
         integer_only=True,
-        bitmask_words=bitmask.num_words(chunk),
+        bitmask_words=words,
     )
 
 
@@ -662,6 +704,10 @@ from p2p_gossip_tpu.staticcheck.registry import register_entry  # noqa: E402
 register_entry(
     "parallel.engine_sharded.flood_runner",
     spec=_audit_spec_flood_runner,
+)
+register_entry(
+    "parallel.engine_sharded.flood_runner[telemetry]",
+    spec=lambda: _audit_spec_flood_runner(telemetry_on=True),
 )
 
 
@@ -723,13 +769,16 @@ def run_sharded_sim(
         bitmask.num_words(chunk_size), ell_idx, ell_delay, ell_mask,
         block=block, bucket_min_rows=bucket_min_rows,
     )
+    tel = telemetry.rings_enabled()
     runner, pass_size = build_sharded_runner(
         mesh, n_padded, ring, chunk_size, horizon_ticks, block, uniform,
         len(boundaries),
         loss.static_cfg if loss is not None else None,
         ring_mode=ring_mode, delay_values=delay_values,
         connect_tick=connect_tick, bucket_counts=bucket_counts,
+        telemetry_on=tel,
     )
+    n_share_shards = mesh.shape[SHARES_AXIS]
 
     received = np.zeros(n_padded, dtype=np.int64)
     sent = np.zeros(n_padded, dtype=np.int64)
@@ -776,14 +825,30 @@ def run_sharded_sim(
             origins, gen_ticks = chunk.padded(pass_size, horizon_ticks)
             t_start = np.int32(chunk.gen_ticks[live].min())
             last_gen = np.int32(chunk.gen_ticks[live].max())
-            r, s, sn, _ = runner(
-                ell_args, degree, churn_start, churn_end,
-                origins, gen_ticks, t_start, last_gen, snap_ticks_arr,
-            )
-            received += np.asarray(r, dtype=np.int64)
-            sent += np.asarray(s, dtype=np.int64)
-            if boundaries:
-                snap_received += np.asarray(sn, dtype=np.int64)
+            with telemetry.span(
+                "dispatch", kernel="parallel.engine_sharded.flood_runner",
+                chunk=ci,
+            ):
+                out = runner(
+                    ell_args, degree, churn_start, churn_end,
+                    origins, gen_ticks, t_start, last_gen, snap_ticks_arr,
+                )
+            if tel:
+                r, s, sn, _, met = out
+            else:
+                r, s, sn, _ = out
+            with telemetry.span("d2h", chunk=ci):
+                received += np.asarray(r, dtype=np.int64)
+                sent += np.asarray(s, dtype=np.int64)
+                if boundaries:
+                    snap_received += np.asarray(sn, dtype=np.int64)
+            if tel:
+                met_np = np.asarray(met)
+                for k in range(n_share_shards):
+                    tel_rings.emit_ring(
+                        "parallel.engine_sharded.run_sharded_sim",
+                        met_np[k], t0=int(t_start), chunk=ci, shard=k,
+                    )
 
     received = received[: graph.n]
     sent = sent[: graph.n]
@@ -848,19 +913,33 @@ def run_sharded_flood_coverage(
         block=block, bucket_min_rows=bucket_min_rows,
     )
     _rss_log("ring staged")
+    tel = telemetry.rings_enabled()
     runner, pass_size = build_sharded_runner(
         mesh, n_padded, ring, chunk_size, horizon_ticks, block, uniform,
         0, loss.static_cfg if loss is not None else None, True, cov_slots,
         ring_mode=ring_mode, delay_values=delay_values,
-        bucket_counts=bucket_counts,
+        bucket_counts=bucket_counts, telemetry_on=tel,
     )
     o, g_ticks = sched.padded(pass_size, horizon_ticks)
     _rss_log("runner built")
-    r, snt, _, cov = runner(
-        ell_args, degree, churn_start, churn_end,
-        o, g_ticks, np.int32(0), np.int32(0),
-        np.zeros((0,), dtype=np.int32),
-    )
+    with telemetry.span(
+        "dispatch", kernel="parallel.engine_sharded.flood_runner"
+    ):
+        out = runner(
+            ell_args, degree, churn_start, churn_end,
+            o, g_ticks, np.int32(0), np.int32(0),
+            np.zeros((0,), dtype=np.int32),
+        )
+    if tel:
+        r, snt, _, cov, met = out
+        met_np = np.asarray(met)
+        for k in range(n_share_shards):
+            tel_rings.emit_ring(
+                "parallel.engine_sharded.run_sharded_flood_coverage",
+                met_np[k], t0=0, shard=k,
+            )
+    else:
+        r, snt, _, cov = out
     _rss_log("runner executed")
     generated = effective_generated(sched, horizon_ticks, churn)
     received = np.asarray(r, dtype=np.int64)[: graph.n]

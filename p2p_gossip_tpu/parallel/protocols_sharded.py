@@ -63,6 +63,8 @@ from p2p_gossip_tpu.parallel.engine_sharded import (
     _padded_device_graph,
 )
 from p2p_gossip_tpu.parallel.mesh import NODES_AXIS, SHARES_AXIS
+from p2p_gossip_tpu import telemetry
+from p2p_gossip_tpu.telemetry import rings as tel_rings
 from p2p_gossip_tpu.utils.stats import NodeStats
 
 
@@ -89,17 +91,24 @@ def build_partnered_runner(
     record_coverage: bool = False,
     ring_mode: str = "replicated",
     delay_values: tuple | None = None,
+    telemetry_on: bool = False,
 ):
     """Compile the per-pass runner for a random-partner protocol over the
     mesh. Memoized on mesh/shapes like engine_sharded.build_sharded_runner.
 
     Counters come back stacked per share-shard — (n_share_shards, n_padded)
     int32 received and uint32 sent lo/hi pairs — and the host folds them in
-    int64 (a psum of the raw u64 halves would drop carries)."""
+    int64 (a psum of the raw u64 halves would drop carries).
+
+    ``telemetry_on`` (static) carries a (horizon, NUM_METRICS) metric
+    ring through the round loop (rows psum'ed over node shards; one ring
+    per share-shard, stacked like the counters) — one extra trailing
+    output."""
     if protocol not in ("pushpull", "pull", "pushk"):
         raise ValueError(f"unknown protocol {protocol!r}")
     if fanout < 1:
         raise ValueError(f"fanout must be >= 1, got {fanout}")
+    tel = tel_rings.active(telemetry_on)
     n_share_shards = mesh.shape[SHARES_AXIS]
     n_node_shards = mesh.shape[NODES_AXIS]
     n_loc = n_padded // n_node_shards
@@ -139,9 +148,11 @@ def build_partnered_runner(
                 dtype=jnp.int32,
             ),                                                    # coverage
         )
+        if tel:
+            state = state + (tel_rings.init(horizon),)            # metrics
 
         def body(t, state):
-            seen, hist, received, sent_lo, sent_hi, cov_hist = state
+            seen, hist, received, sent_lo, sent_hi, cov_hist = state[:6]
             t = jnp.int32(t)
             if anti:
                 kidx = pick_index_jnp(node_ids, t, 0, degree, seed)
@@ -258,12 +269,40 @@ def build_partnered_runner(
 
             if anti:
                 incoming = (remote | pushed_local) & ~seen
-                received = received + bitmask.popcount_rows(incoming)
+                newly_cnt = bitmask.popcount_rows(incoming)
+                if tel:
+                    newbits = incoming | (gen_bits & ~seen)
+                    gathered = tel_rings.total_bits(remote | pushed_local)
+                    if loss is None:
+                        dropped = jnp.uint32(0)
+                    else:
+                        dropped = tel_rings.u32sum(
+                            jnp.where(attempted & ~pull_ok, pc_remote, 0)
+                        )
+                        if protocol != "pull":
+                            dropped = dropped + tel_rings.u32sum(
+                                jnp.where(
+                                    attempted & ~push_ok,
+                                    bitmask.popcount_rows(my_old), 0,
+                                )
+                            )
+                received = received + newly_cnt
                 seen = seen | incoming | gen_bits
                 exchange = seen                       # hist holds seen-state
             else:
                 newly = pushed_local & ~seen
-                received = received + bitmask.popcount_rows(newly)
+                newly_cnt = bitmask.popcount_rows(newly)
+                if tel:
+                    newbits = newly | (gen_bits & ~seen)
+                    gathered = tel_rings.total_bits(pushed_local)
+                    dropped = (
+                        jnp.uint32(0)
+                        if loss is None
+                        else tel_rings.u32sum(
+                            jnp.where(attempted & ~push_ok, pick_cnt, 0)
+                        )
+                    )
+                received = received + newly_cnt
                 seen = seen | newly | gen_bits
                 exchange = newly | gen_bits           # hist holds frontier
             if sharded_ring:
@@ -280,14 +319,31 @@ def build_partnered_runner(
                 cov_hist = lax.dynamic_update_slice(
                     cov_hist, cov[None], (t, 0)
                 )
-            return (seen, hist, received, sent_lo, sent_hi, cov_hist)
+            out = (seen, hist, received, sent_lo, sent_hi, cov_hist)
+            if tel:
+                pc_newbits = bitmask.popcount_rows(newbits)
+                met_row = lax.psum(
+                    tel_rings.row(
+                        frontier_bits=tel_rings.u32sum(pc_newbits),
+                        frontier_nodes=tel_rings.u32sum(pc_newbits > 0),
+                        newly_infected=tel_rings.u32sum(newly_cnt),
+                        msgs_gathered=gathered,
+                        or_work=tel_rings.u32sum(sent_add),
+                        loss_dropped=dropped,
+                    ),
+                    NODES_AXIS,
+                )
+                out = out + (tel_rings.write(state[6], t, met_row),)
+            return out
 
-        seen, _, received, sent_lo, sent_hi, cov_hist = lax.fori_loop(
-            0, horizon, body, state
-        )
+        loop_out = lax.fori_loop(0, horizon, body, state)
+        seen, _, received, sent_lo, sent_hi, cov_hist = loop_out[:6]
         # Stack per share-shard (host folds in int64; psum of u32 halves
         # would drop carries).
-        return received[None], sent_lo[None], sent_hi[None], cov_hist[None]
+        out = (received[None], sent_lo[None], sent_hi[None], cov_hist[None])
+        if tel:
+            out = out + (loop_out[6][None],)
+        return out
 
     mapped = shard_map(
         pass_fn,
@@ -307,7 +363,8 @@ def build_partnered_runner(
             P(SHARES_AXIS, NODES_AXIS),
             P(SHARES_AXIS, NODES_AXIS),
             P(SHARES_AXIS, None, None),  # coverage (psum'ed over nodes)
-        ),
+        )
+        + ((P(SHARES_AXIS, None, None),) if tel else ()),
         check_vma=False,
     )
     return jax.jit(mapped), n_share_shards * chunk_size
@@ -315,7 +372,7 @@ def build_partnered_runner(
 
 # --- staticcheck audit spec (p2p_gossip_tpu/staticcheck/) -----------------
 
-def _audit_spec_partnered_runner(protocol: str):
+def _audit_spec_partnered_runner(protocol: str, telemetry_on: bool = False):
     """Stage + build the sharded partnered runner on tiny shapes (same
     mesh policy as the flood audit spec). The u64 ``sent`` counter halves
     come back as (n_share_shards, n_padded) uint32 stacks, so the allowed
@@ -324,6 +381,7 @@ def _audit_spec_partnered_runner(protocol: str):
     from p2p_gossip_tpu.models.topology import erdos_renyi
     from p2p_gossip_tpu.parallel.engine_sharded import _audit_mesh
     from p2p_gossip_tpu.staticcheck.registry import AuditSpec
+    from p2p_gossip_tpu.telemetry.schema import NUM_METRICS
 
     mesh, _ = _audit_mesh()
     n_node_shards = mesh.shape[NODES_AXIS]
@@ -339,10 +397,14 @@ def _audit_spec_partnered_runner(protocol: str):
         mesh, protocol, n_padded, ring, chunk, horizon,
         2 if protocol == "pushk" else 1,
         (1 << 20, 7), False, ring_mode="replicated",
+        telemetry_on=telemetry_on,
     )
     origins = np.zeros(pass_size, dtype=np.int32)
     gen_ticks = np.full(pass_size, horizon, dtype=np.int32)
     gen_ticks[:2] = 0
+    words: tuple = (bitmask.num_words(chunk), n_padded)
+    if telemetry_on:
+        words = words + (NUM_METRICS,)
     return AuditSpec(
         fn=runner,
         args=(
@@ -350,7 +412,7 @@ def _audit_spec_partnered_runner(protocol: str):
             origins, gen_ticks, np.uint32(42),
         ),
         integer_only=True,
-        bitmask_words=(bitmask.num_words(chunk), n_padded),
+        bitmask_words=words,
     )
 
 
@@ -363,6 +425,14 @@ register_entry(
 register_entry(
     "parallel.protocols_sharded.pushk_runner",
     spec=lambda: _audit_spec_partnered_runner("pushk"),
+)
+register_entry(
+    "parallel.protocols_sharded.pushpull_runner[telemetry]",
+    spec=lambda: _audit_spec_partnered_runner("pushpull", telemetry_on=True),
+)
+register_entry(
+    "parallel.protocols_sharded.pushk_runner[telemetry]",
+    spec=lambda: _audit_spec_partnered_runner("pushk", telemetry_on=True),
 )
 
 
@@ -440,12 +510,13 @@ def run_sharded_partnered_sim(
         else None
     )
 
+    tel = telemetry.rings_enabled()
     runner, pass_size = build_partnered_runner(
         mesh, protocol, n_padded, ring, chunk_size, horizon_ticks,
         fanout if protocol == "pushk" else 1,
         loss.static_cfg if loss is not None else None,
         record_coverage,
-        ring_mode=ring_mode, delay_values=delay_values,
+        ring_mode=ring_mode, delay_values=delay_values, telemetry_on=tel,
     )
     seed_arr = np.uint32(seed & 0xFFFFFFFF)
     n_share_shards = mesh.shape[SHARES_AXIS]
@@ -480,10 +551,24 @@ def run_sharded_partnered_sim(
     chunks = schedule.chunk(pass_size) or [schedule]
     for ci, chunk in checkpointed_chunks(chunks, checkpointer, stop_after_chunks):
         origins, gen_ticks = chunk.padded(pass_size, horizon_ticks)
-        r, s_lo, s_hi, cov = runner(
-            ell_idx, ell_delays, degree, churn_start, churn_end,
-            origins, gen_ticks, seed_arr,
-        )
+        with telemetry.span(
+            "dispatch",
+            kernel=f"parallel.protocols_sharded.{protocol}_runner", chunk=ci,
+        ):
+            out = runner(
+                ell_idx, ell_delays, degree, churn_start, churn_end,
+                origins, gen_ticks, seed_arr,
+            )
+        if tel:
+            r, s_lo, s_hi, cov, met = out
+            met_np = np.asarray(met)
+            for k in range(n_share_shards):
+                tel_rings.emit_ring(
+                    f"parallel.protocols_sharded.{protocol}_runner",
+                    met_np[k], t0=0, ticks=horizon_ticks, chunk=ci, shard=k,
+                )
+        else:
+            r, s_lo, s_hi, cov = out
         received += np.asarray(r, dtype=np.int64).sum(axis=0)
         sent += bitmask.combine_u64(
             jnp.asarray(s_lo), jnp.asarray(s_hi)

@@ -19,11 +19,15 @@ Fixtures:
              budget (and a tunnel window) silently
   prng       jax.random key consumed by two samplers without split() —
              correlated streams masquerading as independent replicas
+  telemetry  metric rings forced on with the telemetry flag down — the
+             always-on instrumentation that would silently break the
+             zero-cost contract (telemetry_off.py must flag the ring
+             avals in the supposedly-off trace)
 """
 
 from __future__ import annotations
 
-FIXTURES = ("f64", "recompile", "prng")
+FIXTURES = ("f64", "recompile", "prng", "telemetry")
 
 
 def f64_fixture() -> dict:
@@ -122,6 +126,35 @@ def prng_fixture() -> dict:
     }
 
 
+def telemetry_fixture() -> dict:
+    """Force the metric rings on while the telemetry flag is down (the
+    `rings._FIXTURE_FORCE` backdoor) and run the zero-cost check on one
+    instrumented kernel: the checker must flag ring avals in the
+    telemetry-OFF trace."""
+    import jax
+
+    from p2p_gossip_tpu.staticcheck.telemetry_off import run_telemetry_check
+    from p2p_gossip_tpu.telemetry import rings
+
+    rings._FIXTURE_FORCE = True
+    # Cache discipline matters on BOTH edges: a pre-existing pjit trace
+    # of the kernel would satisfy make_jaxpr without re-running the
+    # (now-forced) trace-time gate, hiding the seeded bug; and a trace
+    # taken while forced would poison the cache for later legitimate
+    # telemetry=False calls.
+    jax.clear_caches()
+    try:
+        report = run_telemetry_check(only=("engine.sync._run_chunk_while",))
+    finally:
+        rings._FIXTURE_FORCE = False
+        jax.clear_caches()
+    return {
+        "fixture": "telemetry",
+        "ok": report["ok"],  # must come back False
+        "violations": report["violations"],
+    }
+
+
 def run_fixture(name: str) -> dict:
     if name == "f64":
         return f64_fixture()
@@ -129,4 +162,6 @@ def run_fixture(name: str) -> dict:
         return recompile_fixture()
     if name == "prng":
         return prng_fixture()
+    if name == "telemetry":
+        return telemetry_fixture()
     raise ValueError(f"unknown fixture {name!r}; valid: {FIXTURES}")

@@ -1,0 +1,168 @@
+"""Telemetry zero-cost checker — "off" must mean OFF, in the jaxpr.
+
+The telemetry layer's claim is that disabled runs pay nothing: the
+metric rings are gated by a static ``telemetry`` flag, and with the flag
+down the traced program is byte-identical to the pre-telemetry kernel.
+That claim is enforced here, not asserted in a docstring:
+
+  T1 no-ring-when-off   the telemetry-OFF trace of every instrumented
+                        entry contains no metric-ring aval anywhere —
+                        no uint32 array whose minor axis is NUM_METRICS
+                        (the ring's signature shape) at rank >= 2.
+  T2 flag-gates         the telemetry-ON trace differs from the OFF
+                        trace (the flag actually instruments — a flag
+                        that became a no-op would silently kill the
+                        subsystem while every test still passed).
+  T3 default-is-off     for directly-jitted kernels, tracing with
+                        ``telemetry=False`` passed explicitly yields a
+                        string-identical jaxpr to the default call —
+                        existing call sites (which pass nothing) are on
+                        the off path.
+
+Instrumented surfaces are discovered from the audit registry by naming
+convention: every ``<name>[telemetry]`` entry is the ON form of
+``<name>``. A new instrumented kernel that registers its pair is checked
+automatically.
+
+The ``telemetry`` regression fixture (scripts/staticcheck.py --fixture
+telemetry) forces the rings on via `telemetry.rings._FIXTURE_FORCE` and
+asserts T1 flags it — proving the checker still catches an always-on
+ring.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+from p2p_gossip_tpu.staticcheck.jaxpr_audit import Violation, _avals_of
+from p2p_gossip_tpu.telemetry.schema import NUM_METRICS
+
+TELEMETRY_SUFFIX = "[telemetry]"
+
+
+def _trace(fn, args, kwargs):
+    import jax
+
+    return jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
+
+
+def _ring_avals(closed) -> list[tuple]:
+    """Shapes of metric-ring-like avals: uint32, rank >= 2, minor axis
+    exactly NUM_METRICS — the ring's unmistakable signature (bitmask
+    word widths are powers of two >= 1; NUM_METRICS is 6)."""
+    found = []
+    for aval in _avals_of(closed):
+        dtype = getattr(aval, "dtype", None)
+        shape = tuple(getattr(aval, "shape", ()))
+        if (
+            dtype is not None
+            and str(dtype) == "uint32"
+            and len(shape) >= 2
+            and shape[-1] == NUM_METRICS
+            and shape not in found
+        ):
+            found.append(shape)
+    return found
+
+
+def telemetry_pairs(entries=None):
+    """(base_entry, on_entry) pairs from the registry's naming
+    convention. ``entries`` overrides the registry for tests."""
+    if entries is None:
+        from p2p_gossip_tpu.staticcheck import entrypoints, registry
+
+        entrypoints.load_all()
+        entries = registry.all_entries()
+    by_name = {e.name: e for e in entries}
+    pairs = []
+    for name, entry in sorted(by_name.items()):
+        if name.endswith(TELEMETRY_SUFFIX):
+            base = by_name.get(name[: -len(TELEMETRY_SUFFIX)])
+            if base is not None:
+                pairs.append((base, entry))
+    return pairs
+
+
+def check_pair(base, on_entry) -> list[Violation]:
+    """Apply T1-T3 to one (off, on) entry pair."""
+    violations: list[Violation] = []
+    try:
+        base_spec = base.spec()
+        on_spec = on_entry.spec()
+    except Exception:
+        return [Violation(
+            on_entry.name, "spec-error",
+            f"telemetry spec failed to build:\n"
+            f"{traceback.format_exc(limit=4)}",
+        )]
+    base_fn = base_spec.fn if base_spec.fn is not None else base.fn
+    on_fn = on_spec.fn if on_spec.fn is not None else on_entry.fn
+    try:
+        off_jaxpr = _trace(base_fn, base_spec.args, base_spec.kwargs)
+        on_jaxpr = _trace(on_fn, on_spec.args, on_spec.kwargs)
+    except Exception:
+        return [Violation(
+            on_entry.name, "trace-error",
+            f"telemetry trace failed:\n{traceback.format_exc(limit=4)}",
+        )]
+
+    # T1 — the off program carries no ring.
+    rings_off = _ring_avals(off_jaxpr)
+    if rings_off:
+        violations.append(Violation(
+            base.name, "telemetry-off-clean",
+            f"telemetry-OFF trace carries metric-ring avals {rings_off} — "
+            "the rings must compile away when disabled (zero-cost "
+            "contract, docs/OBSERVABILITY.md)",
+        ))
+
+    # T2 — the flag actually instruments.
+    if str(on_jaxpr) == str(off_jaxpr):
+        violations.append(Violation(
+            on_entry.name, "telemetry-flag-gates",
+            "telemetry-ON trace is identical to the OFF trace — the "
+            "static flag no longer instruments anything",
+        ))
+
+    # T3 — explicit False == default, for directly-jitted kernels whose
+    # spec kwargs we can extend (factory-built runners bake the flag at
+    # build time, where default-off holds by construction).
+    if base_spec.fn is None and base.fn is not None:
+        try:
+            explicit = _trace(
+                base.fn, base_spec.args,
+                {**base_spec.kwargs, "telemetry": False},
+            )
+            if str(explicit) != str(off_jaxpr):
+                violations.append(Violation(
+                    base.name, "telemetry-default-off",
+                    "telemetry=False traces differently from the default "
+                    "call — existing call sites are not on the off path",
+                ))
+        except Exception:
+            violations.append(Violation(
+                base.name, "trace-error",
+                f"telemetry=False trace failed:\n"
+                f"{traceback.format_exc(limit=4)}",
+            ))
+    return violations
+
+
+def run_telemetry_check(entries=None, only=None) -> dict:
+    """Check every registered telemetry pair. ``only`` (iterable of base
+    names) restricts the sweep — the fixture checks one pair."""
+    pairs = telemetry_pairs(entries)
+    if only is not None:
+        keep = set(only)
+        pairs = [(b, o) for b, o in pairs if b.name in keep]
+    violations: list[Violation] = []
+    names = []
+    for base, on_entry in pairs:
+        names.append(base.name)
+        violations.extend(check_pair(base, on_entry))
+    return {
+        "ok": not violations,
+        "pairs_checked": len(names),
+        "entries": names,
+        "violations": [v.as_dict() for v in violations],
+    }

@@ -1,0 +1,36 @@
+"""Telemetry layer: in-jit metric rings + host span tracing.
+
+Off by default. Enable with ``P2P_TELEMETRY=<path>`` (JSONL stream) or
+the CLI's ``--telemetry``; programmatic: ``telemetry.configure(path)``.
+When off, the device rings compile away (same jaxpr — enforced by
+`staticcheck/telemetry_off.py`) and spans are no-ops.
+
+Layout: `schema` (event contract, jax-free), `sink` (the stream),
+`spans` (host phase timers), `rings` (device per-tick aggregates),
+`chrometrace` (Perfetto/chrome://tracing export). Reports:
+`scripts/run_report.py`. Docs: docs/OBSERVABILITY.md.
+"""
+
+from p2p_gossip_tpu.telemetry.schema import (  # noqa: F401
+    METRIC_COLUMNS,
+    NUM_METRICS,
+    SCHEMA_VERSION,
+    validate_event,
+    validate_stream,
+)
+from p2p_gossip_tpu.telemetry.sink import (  # noqa: F401
+    configure,
+    close,
+    emit,
+    enabled,
+    event_count,
+    events,
+    path,
+    reset,
+    rings_enabled,
+)
+from p2p_gossip_tpu.telemetry.spans import (  # noqa: F401
+    emit_counter,
+    emit_jit_cache_counters,
+    span,
+)

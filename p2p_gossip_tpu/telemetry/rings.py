@@ -1,0 +1,165 @@
+"""Device-side metric rings — fixed-shape per-tick aggregate buffers.
+
+A metric ring is a ``(capacity, NUM_METRICS)`` uint32 array carried
+through a kernel's ``lax.while_loop`` / ``lax.scan`` state; each tick
+writes one row of six aggregate counters (schema.METRIC_COLUMNS) at its
+tick index. The ring comes back as an ordinary kernel output and is
+harvested ONCE per chunk on the host (`emit_ring`) — no host callback,
+no sync, nothing per-tick crosses the jit boundary.
+
+The instrumentation is gated by a STATIC ``telemetry`` flag on every
+kernel: when False (the default) no ring is created, no row is computed,
+and the traced jaxpr is byte-identical to the pre-telemetry program —
+`staticcheck/telemetry_off.py` asserts exactly this, and the
+``telemetry`` regression fixture (`_FIXTURE_FORCE`) proves the check
+still catches an always-on ring.
+
+Overflow bound: rows are uint32, so any per-tick aggregate >= 2^32
+wraps. The largest is ``or_work`` <= (frontier nodes) x dmax and
+``frontier_bits`` <= N x chunk_size; at the 1M-node ladder's telemetry
+shapes (chunk 64) the bound is ~6.4e7 — 64x headroom. Full-width 1M
+chunks (W=128) CAN exceed it; docs/OBSERVABILITY.md documents the wrap.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from p2p_gossip_tpu.ops import bitmask
+from p2p_gossip_tpu.telemetry import sink
+from p2p_gossip_tpu.telemetry.schema import METRIC_COLUMNS, NUM_METRICS
+
+# Test-only: forces the rings on even when the caller passed
+# telemetry=False — the seeded regression the zero-cost staticcheck
+# fixture must keep flagging (scripts/staticcheck.py --fixture telemetry).
+_FIXTURE_FORCE = False
+
+
+def active(telemetry: bool) -> bool:
+    """The one gate every instrumented kernel consults (trace-time)."""
+    return bool(telemetry) or _FIXTURE_FORCE
+
+
+def init(capacity: int) -> jnp.ndarray:
+    """Zeroed (capacity, NUM_METRICS) ring."""
+    return jnp.zeros((capacity, NUM_METRICS), dtype=jnp.uint32)
+
+
+def init_batched(batch: int, capacity: int) -> jnp.ndarray:
+    return jnp.zeros((batch, capacity, NUM_METRICS), dtype=jnp.uint32)
+
+
+def write(ring: jnp.ndarray, t, row: jnp.ndarray) -> jnp.ndarray:
+    """Write one (NUM_METRICS,) row at tick index ``t`` (traced scalar)."""
+    return jax.lax.dynamic_update_slice(ring, row[None], (t, 0))
+
+
+def write_batched(ring: jnp.ndarray, t, rows: jnp.ndarray) -> jnp.ndarray:
+    """Write (B, NUM_METRICS) rows at tick ``t`` of a (B, cap, M) ring."""
+    return jax.lax.dynamic_update_slice(ring, rows[:, None, :], (0, t, 0))
+
+
+def u32sum(x) -> jnp.ndarray:
+    """Modular-uint32 total of an integer array (the documented wrap)."""
+    return jnp.sum(x.astype(jnp.uint32))
+
+
+def total_bits(words: jnp.ndarray) -> jnp.ndarray:
+    """Popcount of a whole uint32 bitmask array, as a uint32 scalar."""
+    return u32sum(bitmask.popcount_rows(words.reshape(-1, words.shape[-1])))
+
+
+def row(
+    frontier_bits,
+    frontier_nodes,
+    newly_infected,
+    msgs_gathered,
+    or_work,
+    loss_dropped,
+) -> jnp.ndarray:
+    """Assemble one ring row in METRIC_COLUMNS order."""
+    return jnp.stack(
+        [
+            jnp.asarray(v, dtype=jnp.uint32)
+            for v in (
+                frontier_bits, frontier_nodes, newly_infected,
+                msgs_gathered, or_work, loss_dropped,
+            )
+        ]
+    )
+
+
+def flood_row(
+    arrivals: jnp.ndarray,        # (N, W) post-loss gather output, pre-churn
+    newly_out: jnp.ndarray,       # (N, W) the tick's new frontier (incl. gens)
+    received_delta: jnp.ndarray,  # (N,) first-time receives this tick
+    degree: jnp.ndarray,          # (N,) int32
+    arrivals_lossless=None,       # (N, W) the same gather with loss off
+) -> jnp.ndarray:
+    """The flood engines' per-tick row (shared by the solo, campaign and
+    sharded tick bodies — all three call `_tick_body`-equivalent math).
+    ``loss_dropped`` is the post-OR popcount delta between the lossless
+    and actual gathers, exact in message *bits* (a bit dropped on every
+    one of its arriving edges counts once)."""
+    pc_new = bitmask.popcount_rows(newly_out)
+    gathered = total_bits(arrivals)
+    dropped = (
+        jnp.uint32(0)
+        if arrivals_lossless is None
+        else total_bits(arrivals_lossless) - gathered
+    )
+    return row(
+        frontier_bits=u32sum(pc_new),
+        frontier_nodes=u32sum(pc_new > 0),
+        newly_infected=u32sum(received_delta),
+        msgs_gathered=gathered,
+        or_work=u32sum(jnp.where(pc_new > 0, degree, 0)),
+        loss_dropped=dropped,
+    )
+
+
+def emit_ring(
+    kernel: str,
+    ring: np.ndarray,
+    *,
+    t0: int = 0,
+    ticks: int | None = None,
+    trim: bool = True,
+    **provenance,
+) -> None:
+    """Harvest one device ring into a ``ring`` event. ``ring`` is the
+    (cap, NUM_METRICS) host copy; rows [t0, t0+ticks) are emitted.
+    ``ticks=None`` infers the span by trimming trailing all-zero rows
+    past ``t0`` (quiescence-exited kernels leave them zero); ``trim``
+    also applies when ticks is given, never trimming below 1 row.
+    Extra keywords (chunk=, replica=, seed=, shard=) ride along as
+    provenance fields. No-op when telemetry is off."""
+    if not sink.enabled():
+        return
+    ring = np.asarray(ring)
+    if ticks is None:
+        nz = np.flatnonzero(ring[t0:].any(axis=1))
+        ticks = int(nz[-1]) + 1 if nz.size else 1
+    elif trim:
+        window = ring[t0 : t0 + int(ticks)]
+        nz = np.flatnonzero(window.any(axis=1))
+        ticks = max(int(nz[-1]) + 1 if nz.size else 1, 1)
+    rows = ring[t0 : t0 + int(ticks)]
+    event = {
+        "type": "ring",
+        "kernel": kernel,
+        "t0": int(t0),
+        "ticks": int(rows.shape[0]),
+        "columns": list(METRIC_COLUMNS),
+        "metrics": {
+            col: [int(v) for v in rows[:, i]]
+            for i, col in enumerate(METRIC_COLUMNS)
+        },
+    }
+    for key, val in provenance.items():
+        if val is not None:
+            event[key] = int(val) if isinstance(val, (np.integer,)) else val
+    sink.emit(event)

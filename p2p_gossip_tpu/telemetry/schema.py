@@ -1,0 +1,151 @@
+"""Telemetry event schema — the one definition every producer and
+consumer shares.
+
+A telemetry stream is JSONL: one JSON object per line, each carrying a
+``type`` field. Producers are the sink (`telemetry/sink.py`); consumers
+are `scripts/run_report.py`, the Chrome-trace exporter
+(`telemetry/chrometrace.py`), and the ci_tier1 smoke validator. This
+module is deliberately jax-free so consumers can import it without
+touching a backend.
+
+Event types (SCHEMA_VERSION 1):
+
+  meta     first line of every stream: {"type": "meta", "schema": 1,
+           "run": {"argv": [...], "utc": iso8601, ...}}
+  span     one closed host span: {"type": "span", "name", "ts", "dur",
+           "depth", "attrs"} — ts/dur in seconds on the run's monotonic
+           clock (ts is the span's start relative to sink configure).
+  ring     one harvested device metric ring: {"type": "ring",
+           "kernel", "t0", "ticks", "columns": METRIC_COLUMNS,
+           "metrics": {column: [per-tick ints]}} plus optional
+           provenance ("chunk", "replica", "seed", "shard").
+  counter  a scalar sample: {"type": "counter", "name", "value"} —
+           used for the PR-3 recompile-sentinel jit-cache sizes.
+
+Ring columns (uint32 on device — see docs/OBSERVABILITY.md for the
+per-engine semantics and the overflow bound):
+
+  frontier_bits   node-share bits newly entering the seen universe this
+                  tick (dedup'ed; includes generations)
+  frontier_nodes  nodes contributing a nonzero new frontier this tick
+  newly_infected  first-time receives this tick (excludes generations —
+                  sums to the run's total ``received`` counter)
+  msgs_gathered   message bits arriving over links this tick, post
+                  OR-reduce, post link-loss (pre node-churn drop)
+  or_work         message volume the tick injects: for flood, edge
+                  messages issued by the new frontier (sum of degree
+                  over frontier nodes); for the partnered protocols,
+                  share bits transmitted in digests/pushes this round
+  loss_dropped    message bits lost in flight to the link-loss coin
+                  this tick (0 when loss is off)
+"""
+
+from __future__ import annotations
+
+SCHEMA_VERSION = 1
+
+METRIC_COLUMNS = (
+    "frontier_bits",
+    "frontier_nodes",
+    "newly_infected",
+    "msgs_gathered",
+    "or_work",
+    "loss_dropped",
+)
+NUM_METRICS = len(METRIC_COLUMNS)
+
+EVENT_TYPES = ("meta", "span", "ring", "counter")
+
+
+def validate_event(event) -> list[str]:
+    """Schema errors for one event dict ([] = valid). Never raises on
+    malformed input — every problem comes back as a message."""
+    errs: list[str] = []
+    if not isinstance(event, dict):
+        return [f"event is {type(event).__name__}, not an object"]
+    etype = event.get("type")
+    if etype not in EVENT_TYPES:
+        return [f"unknown event type {etype!r} (valid: {EVENT_TYPES})"]
+    if etype == "meta":
+        if event.get("schema") != SCHEMA_VERSION:
+            errs.append(
+                f"meta.schema is {event.get('schema')!r}, expected "
+                f"{SCHEMA_VERSION}"
+            )
+        if not isinstance(event.get("run"), dict):
+            errs.append("meta.run must be an object")
+    elif etype == "span":
+        if not isinstance(event.get("name"), str) or not event.get("name"):
+            errs.append("span.name must be a non-empty string")
+        for key in ("ts", "dur"):
+            val = event.get(key)
+            if not isinstance(val, (int, float)) or val < 0:
+                errs.append(f"span.{key} must be a number >= 0")
+        if not isinstance(event.get("depth"), int) or event["depth"] < 0:
+            errs.append("span.depth must be an int >= 0")
+        if "attrs" in event and not isinstance(event["attrs"], dict):
+            errs.append("span.attrs must be an object")
+    elif etype == "ring":
+        if not isinstance(event.get("kernel"), str) or not event.get("kernel"):
+            errs.append("ring.kernel must be a non-empty string")
+        if list(event.get("columns", [])) != list(METRIC_COLUMNS):
+            errs.append(
+                f"ring.columns must be {list(METRIC_COLUMNS)}, got "
+                f"{event.get('columns')!r}"
+            )
+        ticks = event.get("ticks")
+        if not isinstance(ticks, int) or ticks < 0:
+            errs.append("ring.ticks must be an int >= 0")
+        if not isinstance(event.get("t0"), int) or event.get("t0", -1) < 0:
+            errs.append("ring.t0 must be an int >= 0")
+        metrics = event.get("metrics")
+        if not isinstance(metrics, dict):
+            errs.append("ring.metrics must be an object")
+        else:
+            for col in METRIC_COLUMNS:
+                series = metrics.get(col)
+                if not isinstance(series, list):
+                    errs.append(f"ring.metrics.{col} must be a list")
+                elif isinstance(ticks, int) and len(series) != ticks:
+                    errs.append(
+                        f"ring.metrics.{col} has {len(series)} entries, "
+                        f"ticks says {ticks}"
+                    )
+                elif not all(
+                    isinstance(v, int) and v >= 0 for v in series
+                ):
+                    errs.append(
+                        f"ring.metrics.{col} must hold non-negative ints"
+                    )
+    elif etype == "counter":
+        if not isinstance(event.get("name"), str) or not event.get("name"):
+            errs.append("counter.name must be a non-empty string")
+        if not isinstance(event.get("value"), (int, float)):
+            errs.append("counter.value must be a number")
+    return errs
+
+
+def validate_stream(lines) -> list[str]:
+    """Validate an iterable of JSONL lines; returns every error with its
+    1-based line number prefixed. The first event must be a meta."""
+    import json
+
+    errs: list[str] = []
+    first_seen = False
+    for i, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            event = json.loads(line)
+        except json.JSONDecodeError as e:
+            errs.append(f"line {i}: not JSON ({e})")
+            continue
+        if not first_seen:
+            first_seen = True
+            if not (isinstance(event, dict) and event.get("type") == "meta"):
+                errs.append("line 1: first event must be type 'meta'")
+        errs.extend(f"line {i}: {msg}" for msg in validate_event(event))
+    if not first_seen:
+        errs.append("stream is empty (no events)")
+    return errs

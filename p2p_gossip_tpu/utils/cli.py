@@ -269,6 +269,15 @@ def build_parser() -> argparse.ArgumentParser:
         "(also honors the P2P_LOG environment variable)",
     )
     p.add_argument(
+        "--telemetry", type=str, default="", metavar="OUT.jsonl",
+        help="Stream telemetry to this JSONL file: host spans "
+        "(build/schedule/dispatch/d2h phases) plus in-jit per-tick "
+        "metric rings harvested at chunk boundaries "
+        "(docs/OBSERVABILITY.md). Also honors P2P_TELEMETRY=<path>. "
+        "Off by default — disabled runs compile the exact "
+        "uninstrumented kernels. Render with scripts/run_report.py",
+    )
+    p.add_argument(
         "--graphFile", type=str, default="",
         help="npz graph cache: load the topology from this file if it "
         "exists, else build per --topology and save it — graph builds "
@@ -307,12 +316,18 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
     pushk the same experiment runs under that protocol instead of
     flooding — the direct CLI comparison of the protocols'
     coverage-time/redundancy trade-off."""
+    from p2p_gossip_tpu import telemetry
     from p2p_gossip_tpu.engine.sync import run_flood_coverage, time_to_coverage
 
     tick_dt = args.Latency / 1000.0
     rng = np.random.default_rng(args.seed)
     origins = rng.integers(0, g.n, args.floodCoverage).astype(np.int32)
     t0 = time.perf_counter()
+    _sim_span = telemetry.span(
+        "simulate", backend=args.backend, protocol=args.protocol,
+        experiment="flood_coverage",
+    )
+    _sim_span.__enter__()
     mesh = None
     if args.backend == "sharded":
         from p2p_gossip_tpu.parallel.mesh import make_mesh
@@ -374,7 +389,9 @@ def _run_flood_coverage_cli(args, g, horizon, delays, churn, loss) -> int:
             g, origins, horizon, ell_delays=delays,
             block=args.degreeBlock or None, churn=churn, loss=loss,
         )
+    _sim_span.__exit__(None, None, None)
     wall = time.perf_counter() - t0
+    telemetry.emit_jit_cache_counters()
     ttc = time_to_coverage(coverage, g.n, args.coverageFraction)
     reached = ttc >= 0
     print(
@@ -466,6 +483,7 @@ def _run_campaign_cli(args, g, horizon, delays, loss) -> int:
     distribution a single-seed run cannot show."""
     import json
 
+    from p2p_gossip_tpu import telemetry
     from p2p_gossip_tpu.batch.campaign import (
         flood_replicas,
         gossip_replicas,
@@ -490,15 +508,21 @@ def _run_campaign_cli(args, g, horizon, delays, loss) -> int:
         max_outages=args.churnOutages,
     )
     partnered = args.protocol in ("pushpull", "pull", "pushk")
-    if args.floodCoverage:
-        replicas = flood_replicas(
-            g, args.floodCoverage, seeds, horizon, **churn_kw
-        )
-    else:
-        replicas = gossip_replicas(
-            g, args.simTime, args.Latency / 1000.0, seeds, horizon,
-            gen_lo=args.genLo, gen_hi=args.genHi, **churn_kw,
-        )
+    with telemetry.span("replicas", count=args.replicas):
+        if args.floodCoverage:
+            replicas = flood_replicas(
+                g, args.floodCoverage, seeds, horizon, **churn_kw
+            )
+        else:
+            replicas = gossip_replicas(
+                g, args.simTime, args.Latency / 1000.0, seeds, horizon,
+                gen_lo=args.genLo, gen_hi=args.genHi, **churn_kw,
+            )
+    _sim_span = telemetry.span(
+        "simulate", backend=args.backend, protocol=args.protocol,
+        experiment="campaign",
+    )
+    _sim_span.__enter__()
     if partnered:
         try:
             result = run_protocol_campaign(
@@ -521,6 +545,8 @@ def _run_campaign_cli(args, g, horizon, delays, loss) -> int:
             loss_seeds=loss_seeds, chunk_size=args.chunkSize,
             block=args.degreeBlock or None, **ckpt_kw,
         )
+    _sim_span.__exit__(None, None, None)
+    telemetry.emit_jit_cache_counters()
     summary = ensemble_summary(result, args.coverageFraction)
 
     kind = (
@@ -607,6 +633,16 @@ def run(argv=None) -> int:
             print(f"error: --log: {e}", file=sys.stderr)
             return 2
     p2plog.set_time_resolution(tick_dt)
+    from p2p_gossip_tpu import telemetry
+
+    if args.telemetry:
+        # Explicit flag wins over P2P_TELEMETRY (sink.configure replaces
+        # any env-initialized stream).
+        try:
+            telemetry.configure(args.telemetry, rings=True)
+        except OSError as e:
+            print(f"error: --telemetry: {e}", file=sys.stderr)
+            return 2
     horizon = int(round(args.simTime / tick_dt))
 
     if args.sweep:
@@ -739,6 +775,10 @@ def run(argv=None) -> int:
         return 2
 
     parallel_extra = None
+    # Explicit enter/exit rather than a with-block: the builder chain
+    # below has error-returns that should not re-indent under a context.
+    _graph_span = telemetry.span("build_graph", topology=args.topology)
+    _graph_span.__enter__()
     if loaded_graph is not None:
         g = loaded_graph
     elif args.topology == "er":
@@ -794,15 +834,18 @@ def run(argv=None) -> int:
         from p2p_gossip_tpu.models.topology import save_graph_cache
 
         save_graph_cache(args.graphFile, g, fp=graph_fp)
+    _graph_span.__exit__(None, None, None)
 
-    if args.genModel == "uniform":
-        sched = uniform_renewal_schedule(
-            g.n, args.simTime, tick_dt, args.genLo, args.genHi, seed=args.seed
-        )
-    else:
-        sched = poisson_schedule(
-            g.n, args.simTime, tick_dt, args.poissonRate, seed=args.seed
-        )
+    with telemetry.span("schedule", model=args.genModel):
+        if args.genModel == "uniform":
+            sched = uniform_renewal_schedule(
+                g.n, args.simTime, tick_dt, args.genLo, args.genHi,
+                seed=args.seed,
+            )
+        else:
+            sched = poisson_schedule(
+                g.n, args.simTime, tick_dt, args.poissonRate, seed=args.seed
+            )
 
     delays = None
     if args.delayModel == "lognormal":
@@ -1078,6 +1121,10 @@ def run(argv=None) -> int:
         return _run_campaign_cli(args, g, horizon, delays, loss)
 
     t0 = time.perf_counter()
+    _sim_span = telemetry.span(
+        "simulate", backend=args.backend, protocol=args.protocol
+    )
+    _sim_span.__enter__()
     if args.protocol in ("pushpull", "pull", "pushk") and args.backend == "sharded":
         from p2p_gossip_tpu.parallel.mesh import make_mesh
         from p2p_gossip_tpu.parallel.protocols_sharded import (
@@ -1177,7 +1224,9 @@ def run(argv=None) -> int:
             churn=churn, loss=loss, record_messages=args.animMessages,
             connect_tick=args.connectAtTick, fifo_links=fifo,
         )
+    _sim_span.__exit__(None, None, None)
     wall = time.perf_counter() - t0
+    telemetry.emit_jit_cache_counters()
 
     if parallel_extra is not None:
         # Pure reporting transform — the duplicate copies never change

@@ -244,6 +244,36 @@ def main() -> int:
                 print(f"- `{r['entry']}`: {r.get('error', '?')}")
         print()
 
+    tel = by_stage.get("telemetry")
+    if tel and tel["results"]:
+        smokes = [
+            r for r in tel["results"] if r.get("kind") == "telemetry_smoke"
+        ]
+        if smokes:
+            s = smokes[-1]
+            summ = s.get("summary") or {}
+            ring_totals = summ.get("ring_totals") or {}
+            total_newly = sum(
+                agg.get("newly_infected", 0) for agg in ring_totals.values()
+            )
+            print("## Telemetry (in-jit metric rings + host spans, "
+                  "schema-gated)\n")
+            print(md_table([{
+                "ok": s.get("ok"),
+                "events": summ.get("events"),
+                "spans": summ.get("spans"),
+                "rings": summ.get("rings"),
+                "newly_infected_total": total_newly,
+                "expected_receives": s.get("expected_receives"),
+                "errors": len(s.get("errors") or []),
+            }], [
+                "ok", "events", "spans", "rings", "newly_infected_total",
+                "expected_receives", "errors",
+            ]))
+            for err in (s.get("errors") or [])[:5]:
+                print(f"- {err}")
+            print()
+
     prof = by_stage.get("profile")
     if prof and prof["results"]:
         summaries = [

@@ -22,6 +22,19 @@ if ! JAX_PLATFORMS=cpu python scripts/staticcheck.py --json; then
   exit 1
 fi
 
+# Telemetry smoke: a tiny flood through the real CLI with --telemetry,
+# its JSONL stream schema-validated and its ring metrics reconciled
+# against the run's counters (scripts/run_report.py --capture-smoke).
+# Cheap (~10 s) and catches a broken emit path before the long pytest
+# pass; the staticcheck gate above already proved telemetry-OFF runs
+# trace the uninstrumented kernels.
+if ! JAX_PLATFORMS=cpu python scripts/run_report.py --capture-smoke \
+    > /tmp/_t1_telemetry.json; then
+  echo "ci_tier1: FAIL — telemetry smoke (see /tmp/_t1_telemetry.json;" \
+       "run 'python scripts/run_report.py --capture-smoke' to reproduce)" >&2
+  exit 1
+fi
+
 # Marker registration check: `pytest --markers` must list `slow`.
 if ! JAX_PLATFORMS=cpu python -m pytest --markers -p no:cacheprovider 2>/dev/null \
     | grep -q "^@pytest.mark.slow:"; then

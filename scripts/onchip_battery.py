@@ -34,6 +34,14 @@ Stages (value-first within safety bands — see the note after the list):
                hardware shapes). Standard XLA compiles only — no
                execution at scale — so it sits in the safe band after
                campaign and before any 1M stage.
+  telemetry — run_report.py --capture-smoke at a modest on-chip shape:
+               a flood with the in-jit metric rings ON, its JSONL stream
+               schema-validated and the per-tick ring metrics reconciled
+               against the run's final counters — the first hardware
+               execution of the instrumented kernels (today's telemetry
+               evidence is CPU-only, docs/RESULTS.md). Standard XLA, tiny
+               extra carry — safe band, right after staticcheck compiled
+               the same instrumented entries.
   scale1m   — scale_1m.py --shares 64 --chunk 64 -> the 1M ER on-chip
                line at the minimal resident footprint (pad W=2, ~5.2 GB
                modeled = essentially the bare ELL). The full-config
@@ -108,7 +116,7 @@ ART_DIR = os.path.join(REPO, "docs", "artifacts")
 
 STAGE_ORDER = (
     "bench", "protocols", "kernel", "bench_rep2", "bench_rep3",
-    "campaign", "staticcheck",
+    "campaign", "staticcheck", "telemetry",
     "scale1m", "scale1m_ba", "sweep250", "profile", "scale1m_full",
 )
 
@@ -212,6 +220,15 @@ def stage_specs(args) -> dict:
                 "argv": [
                     py, os.path.join(SCRIPTS, "staticcheck.py"),
                     "--json", "--compile",
+                ],
+                "env": cpu,
+                "budget": args.stage_budget or 900,
+            },
+            "telemetry": {
+                # Same pipeline as the ci_tier1 smoke, pinned to CPU.
+                "argv": [
+                    py, os.path.join(SCRIPTS, "run_report.py"),
+                    "--capture-smoke",
                 ],
                 "env": cpu,
                 "budget": args.stage_budget or 900,
@@ -327,6 +344,20 @@ def stage_specs(args) -> dict:
             ],
             "env": sweep_env,
             "budget": args.stage_budget or 1800,
+        },
+        "telemetry": {
+            # First hardware execution of the ring-instrumented kernels:
+            # a 20K-node flood with --telemetry through the real CLI,
+            # stream schema-validated and ring metrics reconciled with
+            # the final counters. Modest shape — far below the bench
+            # config — because the job is validating instrumentation,
+            # not measuring throughput.
+            "argv": [
+                py, os.path.join(SCRIPTS, "run_report.py"),
+                "--capture-smoke", "--nodes", "20000", "--shares", "64",
+            ],
+            "env": sweep_env,
+            "budget": args.stage_budget or 1200,
         },
         "profile": {
             # One profiled bench pass + trace parse. --art-dir follows

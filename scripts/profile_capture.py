@@ -107,6 +107,13 @@ def dedup_per_flag_copies(op_rows: list[dict], summary: dict) -> list[dict]:
     """
     flags = {_infeed_flag(r) for r in op_rows}
     if len(flags) <= 1:
+        if flags == {True}:
+            # Only the infeed-INCLUDED copy is present: nothing to drop,
+            # but the sums now follow the opposite convention from the
+            # kept-copy (infeed-excluded) norm — stamp it so downstream
+            # readers aren't left to infer which convention applies
+            # (round-5 advisor finding).
+            summary["dedup_note"] = "only infeed-included copy present"
         return op_rows
     kept = [r for r in op_rows if not _infeed_flag(r)]
     # A kept copy at/below half is expected (the infeed-included copy

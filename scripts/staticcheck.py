@@ -2,15 +2,17 @@
 
     python scripts/staticcheck.py              # human report
     python scripts/staticcheck.py --json       # one JSON line on stdout
-    python scripts/staticcheck.py --fixture f64|recompile|prng
+    python scripts/staticcheck.py --fixture f64|recompile|prng|telemetry
     python scripts/staticcheck.py --compile    # also lower+compile each
                                                # audited entry on the
                                                # default device (the
                                                # battery's on-chip stage)
 
 Runs, in order: the AST lint (astlint — no jax needed), the jaxpr
-invariant auditor over every registered entry point (jaxpr_audit), and
-the recompile sentinel's sweep-grid replay (recompile). Exit code 1 iff
+invariant auditor over every registered entry point (jaxpr_audit), the
+telemetry zero-cost check (telemetry_off — disabled metric rings must
+compile away), and the recompile sentinel's sweep-grid replay
+(recompile). Exit code 1 iff
 any analyzer reports a violation — which is also the ``--fixture``
 contract: each seeded regression must keep exiting non-zero, and
 tests/test_staticcheck.py asserts exactly that (a broken analyzer shows
@@ -91,7 +93,8 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--json", action="store_true",
                     help="one JSON line on stdout instead of the human report")
-    ap.add_argument("--fixture", choices=("f64", "recompile", "prng"),
+    ap.add_argument("--fixture",
+                    choices=("f64", "recompile", "prng", "telemetry"),
                     help="run one seeded regression fixture; exits non-zero "
                     "iff the analyzer (correctly) flags it")
     ap.add_argument("--lint-only", action="store_true",
@@ -158,6 +161,16 @@ def main() -> int:
         log(f"jaxpr audit: {audit['entries_audited']} entries, "
             f"{len(audit['violations'])} violation(s)")
 
+        from p2p_gossip_tpu.staticcheck.telemetry_off import (
+            run_telemetry_check,
+        )
+
+        tel = run_telemetry_check()
+        report["telemetry"] = tel
+        violations += len(tel["violations"])
+        log(f"telemetry zero-cost: {tel['pairs_checked']} instrumented "
+            f"pair(s), {len(tel['violations'])} violation(s)")
+
         if not args.skip_sentinel:
             from p2p_gossip_tpu.staticcheck.recompile import run_sentinel
 
@@ -196,7 +209,7 @@ def main() -> int:
     else:
         print(f"staticcheck: {'OK' if report['ok'] else 'FAIL'} "
               f"({violations} violation(s), {report['wall_s']}s)")
-        for section in ("lint", "jaxpr", "recompile", "compile"):
+        for section in ("lint", "jaxpr", "telemetry", "recompile", "compile"):
             sec = report.get(section)
             if not sec:
                 continue

@@ -193,7 +193,17 @@ def main() -> int:
         "--no-report", action="store_true",
         help="suppress the human-readable report (JSON lines only)",
     )
+    ap.add_argument(
+        "--telemetry", type=str, default="",
+        help="stream telemetry (per-cell spans + in-jit metric rings) to "
+        "this JSONL file; also honors P2P_TELEMETRY (docs/OBSERVABILITY.md)",
+    )
     args = ap.parse_args()
+
+    if args.telemetry:
+        from p2p_gossip_tpu import telemetry
+
+        telemetry.configure(args.telemetry, rings=True)
 
     force_cpu_backend_if_requested()
     # Same contract as bench.py: a wedged tunnel must not hang the run

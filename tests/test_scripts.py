@@ -639,6 +639,15 @@ def test_profile_dedup_per_flag_copies():
     assert s["op_rows"] == 4
     assert s["measured_hbm_bytes"] == round((9.0 + 2.0) * 100.0 * 1e3 * 2)
 
+    # only the infeed-INCLUDED copy present: nothing to drop, but the
+    # sums now follow the opposite convention from the kept-copy norm —
+    # the summary must self-describe it (round-5 advisor finding)
+    only_inc = rows_with_flags(True, True)
+    summary = {}
+    s = pc.summarize_rows(only_inc, {}, summary)
+    assert s["op_rows"] == 4
+    assert summary["dedup_note"] == "only infeed-included copy present"
+
     # kept copy below half: legitimate (infeed-only extra rows in the
     # included copy) — no note
     below = rows_with_flags(True, False)[:3]  # 2x true-copy, 1x false

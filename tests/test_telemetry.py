@@ -1,0 +1,439 @@
+"""Telemetry layer tests (ISSUE 4): ring bitwise-neutrality across every
+engine, per-tick metrics reconciling with final counters, span
+nesting/monotonicity, JSONL schema, Chrome-trace round trip, env/CLI
+enablement, and the staticcheck zero-cost contract."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import p2p_gossip_tpu as pg
+from p2p_gossip_tpu import telemetry
+from p2p_gossip_tpu.models.linkloss import LinkLossModel
+from p2p_gossip_tpu.telemetry import chrometrace, rings as tel_rings, schema
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+@pytest.fixture
+def graph():
+    return pg.erdos_renyi(64, 0.12, seed=0)
+
+
+@pytest.fixture
+def sched(graph):
+    rng = np.random.default_rng(0)
+    return pg.Schedule(
+        graph.n,
+        rng.integers(0, graph.n, 6).astype(np.int32),
+        rng.integers(0, 4, 6).astype(np.int32),
+    )
+
+
+def ring_events():
+    return [e for e in telemetry.events() if e["type"] == "ring"]
+
+
+def metric_sum(col):
+    return sum(sum(e["metrics"][col]) for e in ring_events())
+
+
+# ---------------------------------------------------------------------------
+# Ring bitwise-neutrality + counter reconciliation, engine by engine
+# ---------------------------------------------------------------------------
+
+def assert_neutral_and_reconciled(run, received_of=None):
+    """Run ``run`` with telemetry off then on: identical results, and the
+    rings' newly_infected must sum to the run's total received."""
+    base = run()
+    telemetry.configure(None, rings=True)
+    instrumented = run()
+    for a, b in zip(base, instrumented):
+        np.testing.assert_array_equal(a, b)
+    assert ring_events(), "no ring events harvested"
+    received = (received_of or (lambda r: int(r[0].sum())))(base)
+    assert metric_sum("newly_infected") == received
+    return base
+
+
+def test_sync_engine_neutral(graph, sched):
+    from p2p_gossip_tpu.engine.sync import run_sync_sim
+
+    def run():
+        s = run_sync_sim(graph, sched, 32)
+        return s.received, s.sent, s.generated
+
+    rec, snt, gen = assert_neutral_and_reconciled(run)
+    # frontier_bits counts every (node, share) bit entering the seen
+    # universe — receives plus generations.
+    assert metric_sum("frontier_bits") == int(rec.sum() + gen.sum())
+
+
+def test_flood_coverage_neutral_with_loss(graph):
+    from p2p_gossip_tpu.engine.sync import run_flood_coverage
+
+    loss = LinkLossModel(0.2, seed=7)
+
+    def run():
+        s, cov = run_flood_coverage(graph, [0, 1, 2, 3], 32, loss=loss)
+        return s.received, s.sent, cov
+
+    assert_neutral_and_reconciled(run)
+    assert metric_sum("loss_dropped") > 0  # the coin fired at p=0.2
+
+
+@pytest.mark.parametrize("proto", ["pushpull", "pull", "pushk"])
+def test_partnered_neutral(graph, sched, proto):
+    from p2p_gossip_tpu.models.protocols import run_pushk_sim, run_pushpull_sim
+
+    loss = LinkLossModel(0.15, seed=3)
+
+    def run():
+        if proto == "pushk":
+            s, cov = run_pushk_sim(
+                graph, sched, 20, fanout=2, seed=1, loss=loss,
+                record_coverage=True,
+            )
+        else:
+            s, cov = run_pushpull_sim(
+                graph, sched, 20, seed=1, loss=loss, record_coverage=True,
+                mode=proto,
+            )
+        return s.received, s.sent, cov, s.generated
+
+    rec, _snt, _cov, gen = assert_neutral_and_reconciled(run)
+    assert metric_sum("frontier_bits") == int(rec.sum() + gen.sum())
+
+
+def test_coverage_campaign_neutral_per_replica(graph):
+    from p2p_gossip_tpu.batch.campaign import (
+        flood_replicas,
+        run_coverage_campaign,
+    )
+
+    reps = flood_replicas(graph, 4, [0, 1, 2], 24)
+
+    def run():
+        r = run_coverage_campaign(graph, reps, 24)
+        return r.received, r.sent, r.coverage
+
+    base = run()
+    telemetry.configure(None, rings=True)
+    inst = run()
+    for a, b in zip(base, inst):
+        np.testing.assert_array_equal(a, b)
+    evs = ring_events()
+    assert len(evs) == 3  # one ring event per replica
+    for e in evs:
+        r = e["replica"]
+        assert sum(e["metrics"]["newly_infected"]) == int(base[0][r].sum())
+
+
+def test_protocol_campaign_neutral_per_replica(graph):
+    from p2p_gossip_tpu.batch.campaign import (
+        flood_replicas,
+        run_protocol_campaign,
+    )
+
+    reps = flood_replicas(graph, 4, [0, 1, 2], 24)
+    loss = LinkLossModel(0.1, seed=2)
+
+    def run():
+        r = run_protocol_campaign(
+            graph, reps, 24, protocol="pushpull", loss=loss,
+            loss_seeds=[5, 6, 7],
+        )
+        return r.received, r.sent, r.coverage
+
+    base = run()
+    telemetry.configure(None, rings=True)
+    inst = run()
+    for a, b in zip(base, inst):
+        np.testing.assert_array_equal(a, b)
+    for e in ring_events():
+        r = e["replica"]
+        assert sum(e["metrics"]["newly_infected"]) == int(base[0][r].sum())
+
+
+def test_gossip_campaign_neutral(graph):
+    from p2p_gossip_tpu.batch.campaign import (
+        gossip_replicas,
+        run_gossip_campaign,
+    )
+
+    reps = gossip_replicas(graph, 20.0, 0.5, [0, 1], 64)
+
+    def run():
+        r = run_gossip_campaign(graph, reps, 64)
+        return r.received, r.sent
+
+    base = run()
+    telemetry.configure(None, rings=True)
+    inst = run()
+    for a, b in zip(base, inst):
+        np.testing.assert_array_equal(a, b)
+    for e in ring_events():
+        r = e["replica"]
+        assert sum(e["metrics"]["newly_infected"]) == int(base[0][r].sum())
+
+
+def test_sharded_flood_neutral(graph):
+    from p2p_gossip_tpu.parallel.engine_sharded import (
+        run_sharded_flood_coverage,
+    )
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(2, 2)
+
+    def run():
+        s, cov = run_sharded_flood_coverage(
+            graph, [0, 1, 2, 3], 24, mesh, chunk_size=32
+        )
+        return s.received, s.sent, cov
+
+    assert_neutral_and_reconciled(run)
+
+
+@pytest.mark.parametrize("proto", ["pushpull", "pushk"])
+def test_sharded_partnered_neutral(graph, sched, proto):
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+    from p2p_gossip_tpu.parallel.protocols_sharded import (
+        run_sharded_partnered_sim,
+    )
+
+    mesh = make_mesh(2, 2)
+    loss = LinkLossModel(0.1, seed=5)
+
+    def run():
+        s, cov = run_sharded_partnered_sim(
+            graph, sched, 16, mesh, protocol=proto, chunk_size=32, seed=3,
+            loss=loss, record_coverage=True,
+        )
+        return s.received, s.sent, cov
+
+    assert_neutral_and_reconciled(run)
+
+
+def test_sync_telemetry_matches_solo_reference(graph, sched):
+    """Telemetry-on counters equal a fresh telemetry-never-configured
+    process state's counters chunk by chunk (regression trap for a ring
+    carry leaking into the counter math)."""
+    from p2p_gossip_tpu.engine.sync import run_sync_sim
+
+    base = run_sync_sim(graph, sched, 32, chunk_size=32)
+    telemetry.configure(None, rings=True)
+    inst = run_sync_sim(graph, sched, 32, chunk_size=32)
+    assert base.totals() == inst.totals()
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_monotonic_clock():
+    telemetry.configure(None, rings=False)
+    with telemetry.span("outer", phase="x"):
+        with telemetry.span("inner"):
+            pass
+        with telemetry.span("inner2"):
+            pass
+    spans = [e for e in telemetry.events() if e["type"] == "span"]
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["outer"]["depth"] == 0
+    assert by_name["inner"]["depth"] == 1
+    assert by_name["inner2"]["depth"] == 1
+    # Children close before the parent, so they are emitted first.
+    assert [s["name"] for s in spans] == ["inner", "inner2", "outer"]
+    # Monotonic clock: ts >= 0, dur >= 0, children inside the parent.
+    outer = by_name["outer"]
+    for s in spans:
+        assert s["ts"] >= 0 and s["dur"] >= 0
+    assert outer["dur"] >= by_name["inner"]["dur"] + by_name["inner2"]["dur"]
+    assert by_name["inner2"]["ts"] >= by_name["inner"]["ts"]
+    assert outer["attrs"] == {"phase": "x"}
+
+
+def test_span_noop_when_disabled():
+    with telemetry.span("never"):
+        pass
+    assert telemetry.events() == []
+    assert not telemetry.enabled()
+
+
+def test_span_records_error_attr():
+    telemetry.configure(None, rings=False)
+    with pytest.raises(ValueError):
+        with telemetry.span("boom"):
+            raise ValueError("x")
+    s = [e for e in telemetry.events() if e["type"] == "span"][0]
+    assert s["attrs"]["error"] == "ValueError"
+
+
+# ---------------------------------------------------------------------------
+# Schema + JSONL stream + Chrome trace
+# ---------------------------------------------------------------------------
+
+def test_event_schema_validators():
+    assert schema.validate_event({"type": "nope"})
+    assert schema.validate_event(
+        {"type": "span", "name": "", "ts": 0, "dur": 0, "depth": 0}
+    )
+    ok_ring = {
+        "type": "ring", "kernel": "k", "t0": 0, "ticks": 2,
+        "columns": list(schema.METRIC_COLUMNS),
+        "metrics": {c: [1, 2] for c in schema.METRIC_COLUMNS},
+    }
+    assert schema.validate_event(ok_ring) == []
+    bad = dict(ok_ring, ticks=3)
+    assert schema.validate_event(bad)  # length mismatch
+
+
+def test_stream_file_is_schema_valid(graph, sched, tmp_path):
+    from p2p_gossip_tpu.engine.sync import run_sync_sim
+
+    stream = tmp_path / "t.jsonl"
+    telemetry.configure(str(stream), rings=True)
+    run_sync_sim(graph, sched, 32)
+    telemetry.close()
+    lines = stream.read_text().splitlines()
+    assert lines, "stream is empty"
+    assert json.loads(lines[0])["type"] == "meta"
+    assert schema.validate_stream(lines) == []
+
+
+def test_chrome_trace_round_trip(graph, sched):
+    from p2p_gossip_tpu.engine.sync import run_sync_sim
+
+    telemetry.configure(None, rings=True)
+    run_sync_sim(graph, sched, 32)
+    events = telemetry.events()
+    trace = chrometrace.to_chrome_trace(events)
+    spans_in = [e for e in events if e["type"] == "span"]
+    spans_out = chrometrace.spans_from_chrome(trace)
+    assert len(spans_out) == len(spans_in)
+    for a, b in zip(
+        sorted(spans_in, key=lambda s: s["ts"]),
+        sorted(spans_out, key=lambda s: s["ts"]),
+    ):
+        assert a["name"] == b["name"]
+        assert a["depth"] == b["depth"]
+        assert abs(a["dur"] - b["dur"]) < 1e-6
+    # Ring columns become device-tick counter series on pid 2.
+    counters = [
+        r for r in trace["traceEvents"]
+        if r.get("ph") == "C" and r.get("pid") == 2
+    ]
+    assert counters
+    n_ring_samples = sum(
+        len(series)
+        for e in events if e["type"] == "ring"
+        for series in e["metrics"].values()
+    )
+    assert len(counters) == n_ring_samples
+
+
+def test_emit_ring_trims_trailing_zeros():
+    telemetry.configure(None, rings=True)
+    ring = np.zeros((8, schema.NUM_METRICS), dtype=np.uint32)
+    ring[1] = 3
+    tel_rings.emit_ring("k", ring, t0=0)
+    ev = ring_events()[0]
+    assert ev["ticks"] == 2  # rows 0..1 kept, trailing zeros trimmed
+    assert schema.validate_event(ev) == []
+
+
+# ---------------------------------------------------------------------------
+# Enablement: env var, CLI flag, off-by-default
+# ---------------------------------------------------------------------------
+
+def test_env_var_enables(tmp_path, monkeypatch):
+    stream = tmp_path / "env.jsonl"
+    monkeypatch.setenv("P2P_TELEMETRY", str(stream))
+    telemetry.reset()  # re-arm the env check
+    assert telemetry.enabled()
+    assert telemetry.rings_enabled()
+    assert stream.exists()  # meta line written on auto-configure
+    telemetry.reset()
+
+
+def test_off_by_default(monkeypatch):
+    monkeypatch.delenv("P2P_TELEMETRY", raising=False)
+    telemetry.reset()
+    assert not telemetry.enabled()
+    assert not telemetry.rings_enabled()
+
+
+def test_cli_flag_writes_stream(tmp_path, capsys):
+    from p2p_gossip_tpu.utils.cli import run as cli_run
+
+    stream = tmp_path / "cli.jsonl"
+    rc = cli_run([
+        "--numNodes", "48", "--connectionProb", "0.1", "--simTime", "0.1",
+        "--Latency", "5", "--floodCoverage", "3", "--telemetry", str(stream),
+        "--json",
+    ])
+    assert rc == 0
+    lines = stream.read_text().splitlines()
+    assert schema.validate_stream(lines) == []
+    kinds = {json.loads(ln)["type"] for ln in lines}
+    assert {"meta", "span", "ring"} <= kinds
+    capsys.readouterr()
+
+
+def test_cli_without_flag_writes_nothing(tmp_path, capsys, monkeypatch):
+    from p2p_gossip_tpu.utils.cli import run as cli_run
+
+    monkeypatch.delenv("P2P_TELEMETRY", raising=False)
+    telemetry.reset()
+    rc = cli_run([
+        "--numNodes", "48", "--connectionProb", "0.1", "--simTime", "0.1",
+        "--Latency", "5", "--floodCoverage", "3", "--json",
+    ])
+    assert rc == 0
+    assert not telemetry.enabled()
+    assert list(tmp_path.iterdir()) == []
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# Zero-cost contract (staticcheck) + fixture
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_zero_cost_check_clean_tree():
+    from p2p_gossip_tpu.staticcheck.telemetry_off import run_telemetry_check
+
+    report = run_telemetry_check()
+    assert report["pairs_checked"] >= 8, report["entries"]
+    assert report["ok"], report["violations"]
+
+
+def test_zero_cost_check_one_pair():
+    from p2p_gossip_tpu.staticcheck.telemetry_off import run_telemetry_check
+
+    report = run_telemetry_check(only=("engine.sync._run_chunk_while",))
+    assert report["pairs_checked"] == 1
+    assert report["ok"], report["violations"]
+
+
+def test_zero_cost_fixture_flags_forced_rings():
+    from p2p_gossip_tpu.staticcheck.fixtures import run_fixture
+
+    report = run_fixture("telemetry")
+    assert report["ok"] is False  # the seeded regression must be flagged
+    rules = {v["rule"] for v in report["violations"]}
+    assert "telemetry-off-clean" in rules
+
+
+def test_ring_signature_shape_is_stable():
+    """The zero-cost checker keys on the ring's (cap, NUM_METRICS)
+    uint32 signature; a column added without updating the checker (and
+    the schema) must fail loudly here."""
+    assert schema.NUM_METRICS == len(schema.METRIC_COLUMNS) == 6
