@@ -385,6 +385,31 @@ def main() -> None:
         log(f"staticcheck: did not complete ({type(e).__name__})")
         staticcheck_ok = None
 
+    # Compiled-cost ledger for the bench kernel family (scripts/
+    # cost_report.py): flops / bytes / compile time per engine.sync
+    # entry, host-CPU subprocess for the same wedged-tunnel isolation as
+    # the audit above. ``platform`` labels the figures — a CPU ledger
+    # never masquerades as chip numbers. None when the ledger could not
+    # be taken; skipped entirely on smoke runs (compiling four kernels
+    # dwarfs the smoke workload).
+    cost = None
+    if not smoke:
+        cr_args = [sys.executable, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts",
+            "cost_report.py"), "--json", "--only", "engine.sync"]
+        try:
+            cr = subprocess.run(
+                cr_args, capture_output=True, text=True, timeout=600,
+                env=sc_env,
+            )
+            if cr.returncode == 0:
+                cost = json.loads(cr.stdout.strip().splitlines()[-1])
+            else:
+                log(f"cost report: FAIL (rc={cr.returncode}) "
+                    f"{cr.stdout[-400:]}")
+        except Exception as e:
+            log(f"cost report: did not complete ({type(e).__name__})")
+
     row = {
         "metric": (
             f"node-updates/sec ({n // 1000}K-node p={p:g} gossip "
@@ -421,6 +446,10 @@ def main() -> None:
         # True/False from the host-CPU audit subprocess; None when the
         # audit itself could not run (never silently green).
         "staticcheck_ok": staticcheck_ok,
+        # Compiled-cost ledger (flops/bytes/compile-s per engine.sync
+        # entry, platform-labeled); None on smoke or when it could not
+        # run.
+        "cost": cost,
     }
     row["campaign"] = {
         "metric": (

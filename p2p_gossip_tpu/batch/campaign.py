@@ -72,6 +72,7 @@ from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
 from p2p_gossip_tpu.staticcheck.registry import audited, register_entry
 from p2p_gossip_tpu import telemetry
+from p2p_gossip_tpu.telemetry import digest as tel_digest
 from p2p_gossip_tpu.telemetry import rings as tel_rings
 from p2p_gossip_tpu.utils import logging as p2plog
 from p2p_gossip_tpu.utils.stats import NodeStats
@@ -294,7 +295,8 @@ def _shard_batch(mesh, arrays):
 
 def _batched_tick(dg, block, t, seen, hist, received, sent,
                   origins_b, gen_ticks_b, churn_b, slots, loss,
-                  loss_seeds_b=None, telemetry_on: bool = False):
+                  loss_seeds_b=None, telemetry_on: bool = False,
+                  digest_on: bool = False):
     """One global tick over the whole (B, ...) replica batch: ``vmap`` of
     the solo engine's ``_tick_body`` (which carries the shared counter
     semantics) over the replica axis, at a COMMON tick counter ``t``.
@@ -312,7 +314,10 @@ def _batched_tick(dg, block, t, seen, hist, received, sent,
     ``telemetry_on`` (static) additionally returns the per-replica
     (B, NUM_METRICS) metric rows the batched kernels write into their
     rings — vmap of the solo tick's row, so replica r's telemetry equals
-    its solo run's.
+    its solo run's. ``digest_on`` (static) appends the per-replica (B,)
+    post-tick state digests (telemetry/digest.py) the same way — XOR
+    folds are lane-local, so replica r's digest stream is bitwise its
+    solo run's.
     """
 
     def tick_one(seen, hist, received, sent, origins, gen_ticks, churn,
@@ -322,12 +327,17 @@ def _batched_tick(dg, block, t, seen, hist, received, sent,
                 dg, block, (t, seen, hist, received, sent), origins, slots,
                 gen_ticks, churn, loss, 0, lseed, telemetry=True,
             )
-            return seen, hist, received, sent, met
-        _, seen, hist, received, sent = _tick_body(
-            dg, block, (t, seen, hist, received, sent), origins, slots,
-            gen_ticks, churn, loss, 0, lseed,
-        )
-        return seen, hist, received, sent
+        else:
+            _, seen, hist, received, sent = _tick_body(
+                dg, block, (t, seen, hist, received, sent), origins, slots,
+                gen_ticks, churn, loss, 0, lseed,
+            )
+        out = (seen, hist, received, sent)
+        if telemetry_on:
+            out = out + (met,)
+        if digest_on:
+            out = out + (tel_digest.tick_digest(seen, received, sent),)
+        return out
 
     args = [seen, hist, received, sent, origins_b, gen_ticks_b]
     if churn_b is None and loss_seeds_b is None:
@@ -382,6 +392,7 @@ def _run_coverage_batch(
     n, w = dg.n, bitmask.num_words(chunk_size)
     b = origins_b.shape[0]
     tel = tel_rings.active(telemetry)
+    dig = tel_digest.active(telemetry)
     cov_slots = chunk_size if coverage_slots is None else coverage_slots
     cov_w = bitmask.num_words(cov_slots)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
@@ -406,6 +417,9 @@ def _run_coverage_batch(
     )
     if tel:
         state = state + (tel_rings.init_batched(b, horizon),)
+    if dig:
+        state = state + (tel_digest.init_batched(b, horizon),)
+    dig_i = 7 + (1 if tel else 0)
 
     def cond(full_state):
         t, hist = full_state[0], full_state[2]
@@ -413,25 +427,23 @@ def _run_coverage_batch(
 
     def step(full_state):
         t, seen, hist, received, sent, cov_run, cov_hist = full_state[:7]
-        if tel:
-            seen, hist, received, sent, met = _batched_tick(
-                dg, block, t, seen, hist, received, sent,
-                origins_b, gen_ticks_b, churn_b, slots, loss, loss_seeds_b,
-                telemetry_on=True,
-            )
-        else:
-            seen, hist, received, sent = _batched_tick(
-                dg, block, t, seen, hist, received, sent,
-                origins_b, gen_ticks_b, churn_b, slots, loss, loss_seeds_b,
-            )
+        seen, hist, received, sent, *extras = _batched_tick(
+            dg, block, t, seen, hist, received, sent,
+            origins_b, gen_ticks_b, churn_b, slots, loss, loss_seeds_b,
+            telemetry_on=tel, digest_on=dig,
+        )
         cov_run = cov_run + cov_delta_of(hist[:, jnp.mod(t, dg.ring_size)])
         cov_hist = jax.lax.dynamic_update_slice(
             cov_hist, cov_run[:, None, :], (0, t, 0)
         )
+        out = (t + 1, seen, hist, received, sent, cov_run, cov_hist)
         if tel:
-            return (t + 1, seen, hist, received, sent, cov_run, cov_hist,
-                    tel_rings.write_batched(full_state[7], t, met))
-        return (t + 1, seen, hist, received, sent, cov_run, cov_hist)
+            out = out + (tel_rings.write_batched(full_state[7], t, extras[0]),)
+        if dig:
+            out = out + (tel_digest.write_batched(
+                full_state[dig_i], t, extras[-1]
+            ),)
+        return out
 
     out = jax.lax.while_loop(cond, step, state)
     t, seen, _, received, sent, cov_run, cov_hist = out[:7]
@@ -440,9 +452,12 @@ def _run_coverage_batch(
     # replica's cov_run stops changing at ITS quiescence.
     ticks = jnp.arange(horizon, dtype=jnp.int32)[None, :, None]
     coverage = jnp.where(ticks >= t, cov_run[:, None, :], cov_hist)
+    ret = (seen, received, sent, coverage)
     if tel:
-        return seen, received, sent, coverage, out[7]
-    return seen, received, sent, coverage
+        ret = ret + (out[7],)
+    if dig:
+        ret = ret + (out[dig_i],)
+    return ret
 
 
 @audited(
@@ -479,6 +494,7 @@ def _run_while_batch(
     b = origins_b.shape[0]
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
     tel = tel_rings.active(telemetry)
+    dig = tel_digest.active(telemetry)
     state = (
         t_start,
         jnp.zeros((b, n, w), dtype=jnp.uint32),
@@ -488,6 +504,9 @@ def _run_while_batch(
     )
     if tel:
         state = state + (tel_rings.init_batched(b, horizon),)
+    if dig:
+        state = state + (tel_digest.init_batched(b, horizon),)
+    dig_i = 5 + (1 if tel else 0)
 
     def cond(state):
         t, hist = state[0], state[2]
@@ -495,25 +514,28 @@ def _run_while_batch(
 
     def body(state):
         t, seen, hist, received, sent = state[:5]
-        if tel:
-            seen, hist, received, sent, met = _batched_tick(
-                dg, block, t, seen, hist, received, sent,
-                origins_b, gen_ticks_b, churn_b, slots, loss, loss_seeds_b,
-                telemetry_on=True,
-            )
-            return (t + 1, seen, hist, received, sent,
-                    tel_rings.write_batched(state[5], t, met))
-        seen, hist, received, sent = _batched_tick(
+        seen, hist, received, sent, *extras = _batched_tick(
             dg, block, t, seen, hist, received, sent,
             origins_b, gen_ticks_b, churn_b, slots, loss, loss_seeds_b,
+            telemetry_on=tel, digest_on=dig,
         )
-        return (t + 1, seen, hist, received, sent)
+        out = (t + 1, seen, hist, received, sent)
+        if tel:
+            out = out + (tel_rings.write_batched(state[5], t, extras[0]),)
+        if dig:
+            out = out + (tel_digest.write_batched(
+                state[dig_i], t, extras[-1]
+            ),)
+        return out
 
     out = jax.lax.while_loop(cond, body, state)
     _, seen, _, received, sent = out[:5]
+    ret = (seen, received, sent)
     if tel:
-        return seen, received, sent, out[5]
-    return seen, received, sent
+        ret = ret + (out[5],)
+    if dig:
+        ret = ret + (out[dig_i],)
+    return ret
 
 
 def _iter_batches(
@@ -753,20 +775,33 @@ def run_coverage_campaign(
                 coverage_slots=s, telemetry=tel,
             )
         if tel:
-            _, r, snt, cov, met = out
+            _, r, snt, cov, met, dstream = out
         else:
             _, r, snt, cov = out
         with telemetry.span("d2h", batch=_bi):
             received[lo : lo + live] = np.asarray(r)[:live]
             sent[lo : lo + live] = np.asarray(snt)[:live]
             coverage[lo : lo + live] = np.asarray(cov)[:live, :, :s]
+        digest_head = None
         if tel:
             met_np = np.asarray(met)
+            dig_np = np.asarray(dstream)
             for i in range(live):
                 tel_rings.emit_ring(
                     "batch.campaign.run_coverage_campaign", met_np[i],
                     t0=0, replica=lo + i, seed=int(replicas.seeds[lo + i]),
                 )
+                tel_digest.emit_digest(
+                    "batch.campaign.run_coverage_campaign", dig_np[i],
+                    t0=0, ticks=horizon, replica=lo + i,
+                    seed=int(replicas.seeds[lo + i]),
+                )
+            nz = np.flatnonzero(dig_np[0]) if live else np.array([])
+            digest_head = int(dig_np[0][nz[-1]]) if nz.size else None
+        telemetry.emit_progress(
+            "batch.campaign.run_coverage_campaign", chunk=_bi,
+            chunks_total=len(batches), digest_head=digest_head,
+        )
     wall = time.perf_counter() - t0
 
     return CampaignResult(
@@ -875,20 +910,34 @@ def run_gossip_campaign(
                     loss=loss_cfg, telemetry=tel,
                 )
             if tel:
-                _, r, snt, met = out
+                _, r, snt, met, dstream = out
             else:
                 _, r, snt = out
             with telemetry.span("d2h", batch=_bi, chunk=ci):
                 received[lo : lo + live] += np.asarray(r, dtype=np.int64)[:live]
                 sent[lo : lo + live] += np.asarray(snt, dtype=np.int64)[:live]
+            digest_head = None
             if tel:
                 met_np = np.asarray(met)
+                dig_np = np.asarray(dstream)
                 for i in range(live):
                     tel_rings.emit_ring(
                         "batch.campaign.run_gossip_campaign", met_np[i],
                         t0=int(t_start), chunk=ci, replica=lo + i,
                         seed=int(replicas.seeds[lo + i]),
                     )
+                    tel_digest.emit_digest(
+                        "batch.campaign.run_gossip_campaign", dig_np[i],
+                        t0=int(t_start), ticks=horizon - int(t_start),
+                        chunk=ci, replica=lo + i,
+                        seed=int(replicas.seeds[lo + i]),
+                    )
+                nz = np.flatnonzero(dig_np[0]) if live else np.array([])
+                digest_head = int(dig_np[0][nz[-1]]) if nz.size else None
+            telemetry.emit_progress(
+                "batch.campaign.run_gossip_campaign", chunk=_bi,
+                chunks_total=len(batches), digest_head=digest_head,
+            )
     wall = time.perf_counter() - t0
 
     return CampaignResult(
@@ -924,8 +973,10 @@ def _audit_spec_batch(kind: str, telemetry_on: bool = False):
     common = dict(chunk_size=chunk, horizon=horizon, block=8, loss=(1 << 20, None))
     words: tuple = (bitmask.num_words(chunk),)
     if telemetry_on:
+        # Per-replica digest rings come back (B, horizon) uint32 — the
+        # horizon is a declared minor width, like NUM_METRICS.
         common["telemetry"] = True
-        words = words + (NUM_METRICS,)
+        words = words + (NUM_METRICS, horizon)
     if kind == "coverage":
         return AuditSpec(
             args=(dg, origins_b, gen_ticks_b, None, lseeds_b),
@@ -1118,7 +1169,7 @@ def run_protocol_campaign(
                         loss_threshold=loss_thr, mode=protocol, telemetry=tel,
                     )
             if tel:
-                _, r, (s_lo, s_hi), cov, met = out
+                _, r, (s_lo, s_hi), cov, met, dstream = out
             else:
                 _, r, (s_lo, s_hi), cov = out
             with telemetry.span("d2h", batch=_bi, chunk=ci):
@@ -1128,14 +1179,28 @@ def run_protocol_campaign(
                     coverage[
                         lo : lo + live, :, ci * chunk : ci * chunk + live_s
                     ] = np.asarray(cov)[:live, :, :live_s]
+            digest_head = None
             if tel:
                 met_np = np.asarray(met)
+                dig_np = np.asarray(dstream)
                 for i in range(live):
                     tel_rings.emit_ring(
                         f"batch.campaign.run_protocol_campaign[{protocol}]",
                         met_np[i], t0=0, ticks=horizon, chunk=ci,
                         replica=lo + i, seed=int(replicas.seeds[lo + i]),
                     )
+                    tel_digest.emit_digest(
+                        f"batch.campaign.run_protocol_campaign[{protocol}]",
+                        dig_np[i], t0=0, ticks=horizon, chunk=ci,
+                        replica=lo + i, seed=int(replicas.seeds[lo + i]),
+                    )
+                if live:
+                    digest_head = int(dig_np[0][-1])
+            telemetry.emit_progress(
+                f"batch.campaign.run_protocol_campaign[{protocol}]",
+                chunk=_bi, chunks_total=len(batches),
+                digest_head=digest_head,
+            )
     wall = time.perf_counter() - t0
 
     return CampaignResult(

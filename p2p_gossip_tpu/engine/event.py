@@ -48,6 +48,7 @@ def run_event_sim(
     record_messages: bool = False,
     connect_tick: int = 0,
     fifo_links=None,
+    on_tick=None,
 ) -> NodeStats:
     """Run the event-driven gossip simulation for ``horizon_ticks`` ticks.
 
@@ -95,6 +96,16 @@ def run_event_sim(
     NetAnim's ``EnablePacketMetadata`` (p2pnetwork.cc:187), here exact
     rather than pcap-level. O(messages) memory: use at visualization
     scale, not at 1M nodes.
+
+    ``on_tick(t, seen, received, sent)`` is an optional per-tick hook,
+    called exactly once for every tick ``t`` in [0, horizon_ticks) —
+    including quiet ticks — AFTER every event of tick ``t`` has been
+    processed (and, under ``fifo_links``, after the tick's queue flush,
+    so ``sent`` is fully charged). The arguments are live views of the
+    engine state (``seen`` is the list of per-node share sets); don't
+    mutate them. This is how the flight recorder's divergence bisector
+    (telemetry/compare.py) digests the host engine's state on the same
+    post-tick boundary the sync kernels digest theirs.
     """
     n = graph.n
     indptr, indices = graph.indptr, graph.indices
@@ -279,6 +290,21 @@ def run_event_sim(
         def is_up(node: int, t: int) -> bool:
             return not ((c_start[node] <= t) & (t < c_end[node])).any()
 
+    # on_tick bookkeeping: cur_t is the first tick not yet finalized.
+    cur_t = 0
+
+    def finalize_ticks(upto: int) -> None:
+        """Fire on_tick for every completed tick in [cur_t, upto) —
+        quiet ticks included, so hook streams align with the sync
+        kernels' one-digest-per-tick rings."""
+        nonlocal cur_t
+        if on_tick is None:
+            cur_t = max(cur_t, upto)
+            return
+        while cur_t < upto:
+            on_tick(cur_t, seen, received, sent)
+            cur_t += 1
+
     t = 0
     while True:
         if fifo and pending and (not heap or heap[0][0] > t):
@@ -290,6 +316,9 @@ def run_event_sim(
             flush_fifo(t)
         if not heap:
             break
+        # Every tick before the heap head is complete (pops are
+        # nondecreasing and any fifo flush for tick t already ran).
+        finalize_ticks(heap[0][0])
         t, ev_seq, kind, node, share = heapq.heappop(heap)
         take_snapshots(t)
         events_processed += 1
@@ -331,6 +360,10 @@ def run_event_sim(
             if arrival_ticks is not None and share < arrival_ticks.shape[0]:
                 arrival_ticks[share, node] = t
             broadcast(node, share, t)
+
+    # Quiescence before the horizon: the remaining ticks are quiet but
+    # still owed to the hook (constant-state digests).
+    finalize_ticks(horizon_ticks)
 
     stats = NodeStats(
         generated=generated.astype(np.int64),

@@ -52,6 +52,7 @@ from p2p_gossip_tpu.ops.ell import (
 )
 from p2p_gossip_tpu.staticcheck.registry import audited, register_entry
 from p2p_gossip_tpu import telemetry
+from p2p_gossip_tpu.telemetry import digest as tel_digest
 from p2p_gossip_tpu.telemetry import rings as tel_rings
 from p2p_gossip_tpu.utils import logging as p2plog
 from p2p_gossip_tpu.utils.stats import NodeStats
@@ -482,15 +483,18 @@ def _run_chunk_while(
     (PrintPeriodicStats, p2pnetwork.cc:231).
 
     ``telemetry`` (static) carries a (horizon, NUM_METRICS) metric ring
-    through the loop and returns it as one extra trailing output — rows
-    [t_start, exit) hold per-tick aggregates, harvested by the host once
-    per chunk (telemetry/rings.py). Off by default; the disabled jaxpr
-    is byte-identical to the pre-telemetry program.
+    plus a (horizon,) digest ring (telemetry/digest.py — one uint32 state
+    digest per tick, the flight recorder) through the loop and returns
+    them as extra trailing outputs, ring first — rows [t_start, exit)
+    hold per-tick values, harvested by the host once per chunk
+    (telemetry/rings.py). Off by default; the disabled jaxpr is
+    byte-identical to the pre-telemetry program.
     """
     n, w = dg.n, bitmask.num_words(chunk_size)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
     k = 0 if snap_ticks is None else snap_ticks.shape[0]
     tel = tel_rings.active(telemetry)
+    dig = tel_digest.active(telemetry)
     state = (
         t_start,
         jnp.zeros((n, w), dtype=jnp.uint32),
@@ -501,6 +505,9 @@ def _run_chunk_while(
     )
     if tel:
         state = state + (tel_rings.init(horizon),)
+    if dig:
+        state = state + (tel_digest.init(horizon),)
+    dig_i = 6 + (1 if tel else 0)
 
     def cond(state):
         t, hist = state[0], state[2]
@@ -519,13 +526,23 @@ def _run_chunk_while(
                 dg, block, (t, seen, hist, received, sent), origins, slots,
                 gen_ticks, churn, loss, connect_tick, telemetry=True,
             )
-            return (t_n, seen, hist, received, sent, snaps,
-                    tel_rings.write(state[6], t, met_row))
-        t, seen, hist, received, sent = _tick_body(
-            dg, block, (t, seen, hist, received, sent), origins, slots,
-            gen_ticks, churn, loss, connect_tick,
-        )
-        return (t, seen, hist, received, sent, snaps)
+        else:
+            t_n, seen, hist, received, sent = _tick_body(
+                dg, block, (t, seen, hist, received, sent), origins, slots,
+                gen_ticks, churn, loss, connect_tick,
+            )
+        out = (t_n, seen, hist, received, sent, snaps)
+        if tel:
+            out = out + (tel_rings.write(state[6], t, met_row),)
+        if dig:
+            # Digest of the POST-tick state at index t: seen words plus
+            # the received/sent counters (flood carries a plain int32
+            # sent — low word only, matching the host twins).
+            out = out + (tel_digest.write(
+                state[dig_i], t,
+                tel_digest.tick_digest(seen, received, sent),
+            ),)
+        return out
 
     out = jax.lax.while_loop(cond, body, state)
     t, seen, hist, received, sent, snaps = out[:6]
@@ -535,9 +552,12 @@ def _run_chunk_while(
     # t - t_start = ticks actually executed (quiescence can stop well
     # before the horizon) — the roofline accounting in bench.py divides
     # measured wall time by this.
+    ret = (seen, received, sent, snaps, t - t_start)
     if tel:
-        return seen, received, sent, snaps, t - t_start, out[6]
-    return seen, received, sent, snaps, t - t_start
+        ret = ret + (out[6],)
+    if dig:
+        ret = ret + (out[dig_i],)
+    return ret
 
 
 @audited(
@@ -580,9 +600,10 @@ def _run_chunk_coverage(
     reduction on TPU. ``coverage_slots`` limits the recorded coverage to
     the first S slots (the live shares) — the chunk itself may be
     lane-padded far wider (MIN_CHUNK_SHARES). ``telemetry`` as in
-    `_run_chunk_while` (one extra trailing metric-ring output)."""
+    `_run_chunk_while` (trailing metric-ring + digest-ring outputs)."""
     n, w = dg.n, bitmask.num_words(chunk_size)
     tel = tel_rings.active(telemetry)
+    dig = tel_digest.active(telemetry)
     cov_slots = chunk_size if coverage_slots is None else coverage_slots
     cov_w = bitmask.num_words(cov_slots)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
@@ -607,6 +628,9 @@ def _run_chunk_coverage(
     )
     if tel:
         state = state + (tel_rings.init(horizon),)
+    if dig:
+        state = state + (tel_digest.init(horizon),)
+    dig_i = 7 + (1 if tel else 0)
 
     def cond(full_state):
         t, hist = full_state[0], full_state[2]
@@ -631,19 +655,29 @@ def _run_chunk_coverage(
         cov_hist = jax.lax.dynamic_update_slice(
             cov_hist, cov_run[None], (t, 0)
         )
+        out = (*new_state, cov_run, cov_hist)
         if tel:
-            return (*new_state, cov_run, cov_hist,
-                    tel_rings.write(full_state[7], t, met_row))
-        return (*new_state, cov_run, cov_hist)
+            out = out + (tel_rings.write(full_state[7], t, met_row),)
+        if dig:
+            out = out + (tel_digest.write(
+                full_state[dig_i], t,
+                tel_digest.tick_digest(
+                    new_state[1], new_state[3], new_state[4]
+                ),
+            ),)
+        return out
 
     out = jax.lax.while_loop(cond, step, state)
     t, seen, _, received, sent, cov_run, cov_hist = out[:7]
     # Rows past quiescence hold the (monotone, now constant) final coverage.
     ticks = jnp.arange(horizon, dtype=jnp.int32)[:, None]
     coverage = jnp.where(ticks >= t, cov_run[None, :], cov_hist)
+    ret = (seen, received, sent, coverage)
     if tel:
-        return seen, received, sent, coverage, out[7]
-    return seen, received, sent, coverage
+        ret = ret + (out[7],)
+    if dig:
+        ret = ret + (out[dig_i],)
+    return ret
 
 
 def run_sync_sim(
@@ -773,7 +807,7 @@ def run_sync_sim(
                     loss=loss_cfg, connect_tick=connect_tick, telemetry=tel,
                 )
             if tel:
-                _, r, s, snaps, t_run, met = out
+                _, r, s, snaps, t_run, met, dstream = out
             else:
                 _, r, s, snaps, t_run = out
             with telemetry.span("d2h", chunk=ci):
@@ -782,11 +816,24 @@ def run_sync_sim(
                 ticks_executed += int(t_run)
                 if boundaries:
                     snap_received += np.asarray(snaps, dtype=np.int64)
+            digest_head = None
             if tel:
                 tel_rings.emit_ring(
                     "engine.sync.run_sync_sim", np.asarray(met),
                     t0=first_t, ticks=int(t_run), chunk=ci,
                 )
+                dvals = np.asarray(dstream)
+                tel_digest.emit_digest(
+                    "engine.sync.run_sync_sim", dvals,
+                    t0=first_t, ticks=int(t_run), chunk=ci,
+                )
+                if int(t_run) > 0:
+                    digest_head = int(dvals[first_t + int(t_run) - 1])
+            telemetry.emit_progress(
+                "engine.sync.run_sync_sim", chunk=ci,
+                chunks_total=len(chunks), ticks_done=ticks_executed,
+                digest_head=digest_head,
+            )
 
     generated = effective_generated(schedule, horizon_ticks, churn)
     degree = np.asarray(dg.degree, dtype=np.int64)
@@ -875,11 +922,22 @@ def run_flood_coverage(
             use_pallas=use_pallas, coverage_slots=s, loss=loss_cfg,
             telemetry=tel,
         )
+    digest_head = None
     if tel:
-        _, r, snt, cov, met = out
+        _, r, snt, cov, met, dstream = out
         tel_rings.emit_ring(
             "engine.sync.run_flood_coverage", np.asarray(met), t0=0,
         )
+        dvals = np.asarray(dstream)
+        # The coverage kernel doesn't report its exit tick; rows past
+        # quiescence were never written and read as zero. Emit the full
+        # horizon and let compare/report trim.
+        tel_digest.emit_digest(
+            "engine.sync.run_flood_coverage", dvals,
+            t0=0, ticks=int(dvals.shape[0]),
+        )
+        nz = np.flatnonzero(dvals)
+        digest_head = int(dvals[nz[-1]]) if nz.size else 0
     else:
         _, r, snt, cov = out
     generated = effective_generated(sched, horizon_ticks, churn)
@@ -893,6 +951,14 @@ def run_flood_coverage(
         degree=np.asarray(dg.degree, dtype=np.int64),
     )
     coverage = np.asarray(cov)[:, :s]
+    telemetry.emit_progress(
+        "engine.sync.run_flood_coverage", chunk=0, chunks_total=1,
+        ticks_done=int(coverage.shape[0]),
+        coverage_pct=(
+            float(coverage[-1].mean()) / dg.n * 100.0 if coverage.size else None
+        ),
+        digest_head=digest_head,
+    )
     stats.extra["coverage"] = coverage
     return stats, coverage
 

@@ -43,6 +43,7 @@ from p2p_gossip_tpu.ops import bitmask
 from p2p_gossip_tpu.ops.segment import scatter_or_auto
 from p2p_gossip_tpu.staticcheck.registry import audited, register_entry
 from p2p_gossip_tpu import telemetry
+from p2p_gossip_tpu.telemetry import digest as tel_digest
 from p2p_gossip_tpu.telemetry import rings as tel_rings
 from p2p_gossip_tpu.utils.stats import NodeStats
 
@@ -84,14 +85,17 @@ def _pushpull_scan(
     traced uint32 scalar (models/linkloss.py).
 
     ``telemetry`` (static) stacks one metric-ring row per round as an
-    extra trailing (horizon, NUM_METRICS) output (telemetry/rings.py) —
-    the scan's ``ys`` stacking is the ring. Off by default; disabled
-    traces are byte-identical to the pre-telemetry program."""
+    extra trailing (horizon, NUM_METRICS) output (telemetry/rings.py)
+    plus one (horizon,) per-round state digest (telemetry/digest.py, the
+    flight recorder — u64 sent pair folded as lo+hi) — the scan's ``ys``
+    stacking is the ring. Off by default; disabled traces are
+    byte-identical to the pre-telemetry program."""
     n, w = dg.n, bitmask.num_words(chunk_size)
     slots = jnp.arange(chunk_size, dtype=jnp.int32)
     ring = dg.ring_size
     use_override = partners_override.ndim == 2
     tel = tel_rings.active(telemetry)
+    dig = tel_digest.active(telemetry)
 
     state = (
         jnp.zeros((n, w), dtype=jnp.uint32),          # seen
@@ -212,17 +216,24 @@ def _pushpull_scan(
             if record_coverage
             else jnp.zeros((0,), jnp.int32)  # nothing stacked when unused
         )
+        extras = ()
         if tel:
-            return (seen, hist, received, sent_lo, sent_hi), (cov, met)
+            extras = extras + (met,)
+        if dig:
+            extras = extras + (tel_digest.tick_digest(
+                seen, received, sent_lo, sent_hi=sent_hi,
+            ),)
+        if extras:
+            return (seen, hist, received, sent_lo, sent_hi), (cov,) + extras
         return (seen, hist, received, sent_lo, sent_hi), cov
 
     state, ys = jax.lax.scan(
         step, state, jnp.arange(horizon, dtype=jnp.int32)
     )
     seen, _, received, sent_lo, sent_hi = state
-    if tel:
-        coverage, met = ys
-        return seen, received, (sent_lo, sent_hi), coverage, met
+    if tel or dig:
+        coverage, *extras = ys
+        return (seen, received, (sent_lo, sent_hi), coverage, *extras)
     return seen, received, (sent_lo, sent_hi), ys
 
 
@@ -508,7 +519,7 @@ def _run_partnered_sim(
                 telemetry=tel,
             )
         if tel:
-            _, r, (s_lo, s_hi), coverage, met = out
+            _, r, (s_lo, s_hi), coverage, met, dstream = out
         else:
             _, r, (s_lo, s_hi), coverage = out
         with telemetry.span("d2h", chunk=ci):
@@ -516,11 +527,24 @@ def _run_partnered_sim(
             sent += bitmask.combine_u64(s_lo, s_hi)
             if record_coverage:
                 cov_chunks.append(np.asarray(coverage)[:, : chunk.num_shares])
+        digest_head = None
         if tel:
             tel_rings.emit_ring(
                 f"models.protocols.{protocol_name}", np.asarray(met),
                 t0=0, ticks=horizon_ticks, chunk=ci,
             )
+            dvals = np.asarray(dstream)
+            tel_digest.emit_digest(
+                f"models.protocols.{protocol_name}", dvals,
+                t0=0, ticks=horizon_ticks, chunk=ci,
+            )
+            if dvals.size:
+                digest_head = int(dvals[-1])
+        telemetry.emit_progress(
+            f"models.protocols.{protocol_name}", chunk=ci,
+            chunks_total=len(chunks), ticks_done=horizon_ticks * (ci + 1),
+            digest_head=digest_head,
+        )
 
     generated = effective_generated(schedule, horizon_ticks, churn)
     stats = NodeStats(
@@ -672,6 +696,7 @@ def _pushk_scan(
     use_override = partners_override.ndim == 3
     rows = jnp.arange(n, dtype=jnp.int32)
     tel = tel_rings.active(telemetry)
+    dig = tel_digest.active(telemetry)
 
     state = (
         jnp.zeros((n, w), dtype=jnp.uint32),          # seen
@@ -758,17 +783,24 @@ def _pushk_scan(
             if record_coverage
             else jnp.zeros((0,), jnp.int32)
         )
+        extras = ()
         if tel:
-            return (seen, hist, received, sent_lo, sent_hi), (cov, met)
+            extras = extras + (met,)
+        if dig:
+            extras = extras + (tel_digest.tick_digest(
+                seen, received, sent_lo, sent_hi=sent_hi,
+            ),)
+        if extras:
+            return (seen, hist, received, sent_lo, sent_hi), (cov,) + extras
         return (seen, hist, received, sent_lo, sent_hi), cov
 
     state, ys = jax.lax.scan(
         step, state, jnp.arange(horizon, dtype=jnp.int32)
     )
     seen, _, received, sent_lo, sent_hi = state
-    if tel:
-        coverage, met = ys
-        return seen, received, (sent_lo, sent_hi), coverage, met
+    if tel or dig:
+        coverage, *extras = ys
+        return (seen, received, (sent_lo, sent_hi), coverage, *extras)
     return seen, received, (sent_lo, sent_hi), ys
 
 
@@ -971,8 +1003,10 @@ def _audit_spec_replicas(protocol: str, telemetry: bool = False):
     # the node axis is a legal uint32 minor dim alongside the words.
     words: tuple = (bitmask.num_words(chunk), dg.n)
     if telemetry:
+        # Per-replica digest streams stack as (B, horizon) uint32 — the
+        # horizon is a declared minor width, like NUM_METRICS.
         kwargs["telemetry"] = True
-        words = words + (NUM_METRICS,)
+        words = words + (NUM_METRICS, horizon)
     return AuditSpec(
         args=(dg, origins_b, gen_ticks_b, seeds_b, lseeds_b),
         kwargs=kwargs,

@@ -65,6 +65,7 @@ from p2p_gossip_tpu.ops.ell import (
 )
 from p2p_gossip_tpu.parallel.mesh import NODES_AXIS, SHARES_AXIS, pad_to_multiple
 from p2p_gossip_tpu import telemetry
+from p2p_gossip_tpu.telemetry import digest as tel_digest
 from p2p_gossip_tpu.telemetry import rings as tel_rings
 from p2p_gossip_tpu.utils.stats import NodeStats
 
@@ -376,6 +377,7 @@ def build_sharded_runner(
     n_loc = n_padded // n_node_shards
     w = bitmask.num_words(chunk_size)
     tel = tel_rings.active(telemetry_on)
+    dig = tel_digest.active(telemetry_on)
     if cov_slots is None:
         cov_slots = chunk_size
     cov_w = bitmask.num_words(cov_slots)
@@ -420,6 +422,9 @@ def build_sharded_runner(
         )
         if tel:
             state = state + (tel_rings.init(horizon),)            # metrics
+        dig_i = 8 + (1 if tel else 0)
+        if dig:
+            state = state + (tel_digest.init(horizon),)           # digests
 
         def cond(state):
             t, _, hist = state[0], state[1], state[2]
@@ -584,6 +589,16 @@ def build_sharded_runner(
                     NODES_AXIS,
                 )
                 out = out + (tel_rings.write(state[8], t, met_row),)
+            if dig:
+                # Global node ids make the salts mesh-shape-invariant;
+                # the node-pad rows are all-zero and the sparse fold
+                # skips them, so this equals the solo digest bit-for-bit.
+                dval = tel_digest.tick_digest_sharded(
+                    seen, received, sent,
+                    node_ids=row_offset + jnp.arange(n_loc, dtype=jnp.int32),
+                    axis_name=NODES_AXIS,
+                )
+                out = out + (tel_digest.write(state[dig_i], t, dval),)
             return out
 
         loop_out = lax.while_loop(cond, body, state)
@@ -601,11 +616,14 @@ def build_sharded_runner(
         received = lax.psum(received, SHARES_AXIS)
         sent = lax.psum(sent, SHARES_AXIS)
         snaps = lax.psum(snaps, SHARES_AXIS)
+        outs = (received, sent, snaps, cov_hist)
         if tel:
             # Stack per share-shard: each shard's ring is its chunk's
             # telemetry (the host emits one event per shard).
-            return received, sent, snaps, cov_hist, loop_out[8][None]
-        return received, sent, snaps, cov_hist
+            outs = outs + (loop_out[8][None],)
+        if dig:
+            outs = outs + (loop_out[dig_i][None],)
+        return outs
 
     # Per bucket triple: rows (S, R) + idx/mask (S, R, C), all with the
     # shard axis leading — splitting it hands each device its own
@@ -638,7 +656,8 @@ def build_sharded_runner(
             P(NODES_AXIS), P(NODES_AXIS), P(None, NODES_AXIS),
             P(None, SHARES_AXIS),
         )
-        + ((P(SHARES_AXIS, None, None),) if tel else ()),
+        + ((P(SHARES_AXIS, None, None),) if tel else ())
+        + ((P(SHARES_AXIS, None),) if dig else ()),
         check_vma=False,
     )
     return jax.jit(mapped), n_share_shards * chunk_size
@@ -687,7 +706,9 @@ def _audit_spec_flood_runner(telemetry_on: bool = False):
     gen_ticks[:2] = 0
     words: tuple = (bitmask.num_words(chunk),)
     if telemetry_on:
-        words = words + (NUM_METRICS,)
+        # Stacked per-shard digest rings are (1, horizon) uint32 — the
+        # horizon is a declared minor width, like NUM_METRICS.
+        words = words + (NUM_METRICS, horizon)
     return AuditSpec(
         fn=runner,
         args=(
@@ -834,7 +855,7 @@ def run_sharded_sim(
                     origins, gen_ticks, t_start, last_gen, snap_ticks_arr,
                 )
             if tel:
-                r, s, sn, _, met = out
+                r, s, sn, _, met, dstream = out
             else:
                 r, s, sn, _ = out
             with telemetry.span("d2h", chunk=ci):
@@ -842,13 +863,33 @@ def run_sharded_sim(
                 sent += np.asarray(s, dtype=np.int64)
                 if boundaries:
                     snap_received += np.asarray(sn, dtype=np.int64)
+            digest_head = None
             if tel:
                 met_np = np.asarray(met)
+                dig_np = np.asarray(dstream)
                 for k in range(n_share_shards):
                     tel_rings.emit_ring(
                         "parallel.engine_sharded.run_sharded_sim",
                         met_np[k], t0=int(t_start), chunk=ci, shard=k,
                     )
+                    # Rows past quiescence were never written (zero);
+                    # trim them like emit_ring does.
+                    nz = np.flatnonzero(dig_np[k])
+                    ticks_k = (
+                        int(nz[-1]) + 1 - int(t_start) if nz.size else 0
+                    )
+                    tel_digest.emit_digest(
+                        "parallel.engine_sharded.run_sharded_sim",
+                        dig_np[k], t0=int(t_start), ticks=ticks_k,
+                        chunk=ci, shard=k,
+                    )
+                    if k == 0 and nz.size:
+                        digest_head = int(dig_np[0][nz[-1]])
+            telemetry.emit_progress(
+                "parallel.engine_sharded.run_sharded_sim",
+                chunk=ci, chunks_total=len(chunks),
+                digest_head=digest_head,
+            )
 
     received = received[: graph.n]
     sent = sent[: graph.n]
@@ -930,14 +971,24 @@ def run_sharded_flood_coverage(
             o, g_ticks, np.int32(0), np.int32(0),
             np.zeros((0,), dtype=np.int32),
         )
+    digest_head = None
     if tel:
-        r, snt, _, cov, met = out
+        r, snt, _, cov, met, dstream = out
         met_np = np.asarray(met)
+        dig_np = np.asarray(dstream)
         for k in range(n_share_shards):
             tel_rings.emit_ring(
                 "parallel.engine_sharded.run_sharded_flood_coverage",
                 met_np[k], t0=0, shard=k,
             )
+            nz = np.flatnonzero(dig_np[k])
+            tel_digest.emit_digest(
+                "parallel.engine_sharded.run_sharded_flood_coverage",
+                dig_np[k], t0=0,
+                ticks=int(nz[-1]) + 1 if nz.size else 0, shard=k,
+            )
+            if k == 0 and nz.size:
+                digest_head = int(dig_np[0][nz[-1]])
     else:
         r, snt, _, cov = out
     _rss_log("runner executed")
@@ -959,6 +1010,15 @@ def run_sharded_flood_coverage(
         live_k = min(max(s - k * chunk_size, 0), chunk_size)
         parts.append(cov[:, k * cov_slots : k * cov_slots + live_k])
     coverage = np.concatenate(parts, axis=1)
+    telemetry.emit_progress(
+        "parallel.engine_sharded.run_sharded_flood_coverage",
+        chunk=0, chunks_total=1, ticks_done=int(coverage.shape[0]),
+        coverage_pct=(
+            float(coverage[-1].mean()) / graph.n * 100.0
+            if coverage.size else None
+        ),
+        digest_head=digest_head,
+    )
     stats.extra["coverage"] = coverage
     stats.extra["ring"] = ring_extra
     return stats, coverage

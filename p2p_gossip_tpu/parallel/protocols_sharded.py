@@ -64,6 +64,7 @@ from p2p_gossip_tpu.parallel.engine_sharded import (
 )
 from p2p_gossip_tpu.parallel.mesh import NODES_AXIS, SHARES_AXIS
 from p2p_gossip_tpu import telemetry
+from p2p_gossip_tpu.telemetry import digest as tel_digest
 from p2p_gossip_tpu.telemetry import rings as tel_rings
 from p2p_gossip_tpu.utils.stats import NodeStats
 
@@ -109,6 +110,7 @@ def build_partnered_runner(
     if fanout < 1:
         raise ValueError(f"fanout must be >= 1, got {fanout}")
     tel = tel_rings.active(telemetry_on)
+    dig = tel_digest.active(telemetry_on)
     n_share_shards = mesh.shape[SHARES_AXIS]
     n_node_shards = mesh.shape[NODES_AXIS]
     n_loc = n_padded // n_node_shards
@@ -150,6 +152,9 @@ def build_partnered_runner(
         )
         if tel:
             state = state + (tel_rings.init(horizon),)            # metrics
+        dig_i = 6 + (1 if tel else 0)
+        if dig:
+            state = state + (tel_digest.init(horizon),)           # digests
 
         def body(t, state):
             seen, hist, received, sent_lo, sent_hi, cov_hist = state[:6]
@@ -334,6 +339,16 @@ def build_partnered_runner(
                     NODES_AXIS,
                 )
                 out = out + (tel_rings.write(state[6], t, met_row),)
+            if dig:
+                # Global node ids keep the salts mesh-shape-invariant; the
+                # ELL-pad rows stay all-zero and the sparse fold skips
+                # them, so this equals the solo protocol digest.
+                dval = tel_digest.tick_digest_sharded(
+                    seen, received, sent_lo,
+                    node_ids=node_ids, axis_name=NODES_AXIS,
+                    sent_hi=sent_hi,
+                )
+                out = out + (tel_digest.write(state[dig_i], t, dval),)
             return out
 
         loop_out = lax.fori_loop(0, horizon, body, state)
@@ -343,6 +358,8 @@ def build_partnered_runner(
         out = (received[None], sent_lo[None], sent_hi[None], cov_hist[None])
         if tel:
             out = out + (loop_out[6][None],)
+        if dig:
+            out = out + (loop_out[dig_i][None],)
         return out
 
     mapped = shard_map(
@@ -364,7 +381,8 @@ def build_partnered_runner(
             P(SHARES_AXIS, NODES_AXIS),
             P(SHARES_AXIS, None, None),  # coverage (psum'ed over nodes)
         )
-        + ((P(SHARES_AXIS, None, None),) if tel else ()),
+        + ((P(SHARES_AXIS, None, None),) if tel else ())
+        + ((P(SHARES_AXIS, None),) if dig else ()),
         check_vma=False,
     )
     return jax.jit(mapped), n_share_shards * chunk_size
@@ -404,7 +422,9 @@ def _audit_spec_partnered_runner(protocol: str, telemetry_on: bool = False):
     gen_ticks[:2] = 0
     words: tuple = (bitmask.num_words(chunk), n_padded)
     if telemetry_on:
-        words = words + (NUM_METRICS,)
+        # Stacked per-shard digest rings are (1, horizon) uint32 — the
+        # horizon is a declared minor width, like NUM_METRICS.
+        words = words + (NUM_METRICS, horizon)
     return AuditSpec(
         fn=runner,
         args=(
@@ -559,16 +579,28 @@ def run_sharded_partnered_sim(
                 ell_idx, ell_delays, degree, churn_start, churn_end,
                 origins, gen_ticks, seed_arr,
             )
+        digest_head = None
         if tel:
-            r, s_lo, s_hi, cov, met = out
+            r, s_lo, s_hi, cov, met, dstream = out
             met_np = np.asarray(met)
+            dig_np = np.asarray(dstream)
             for k in range(n_share_shards):
                 tel_rings.emit_ring(
                     f"parallel.protocols_sharded.{protocol}_runner",
                     met_np[k], t0=0, ticks=horizon_ticks, chunk=ci, shard=k,
                 )
+                tel_digest.emit_digest(
+                    f"parallel.protocols_sharded.{protocol}_runner",
+                    dig_np[k], t0=0, ticks=horizon_ticks, chunk=ci, shard=k,
+                )
+            digest_head = int(dig_np[0][-1])
         else:
             r, s_lo, s_hi, cov = out
+        telemetry.emit_progress(
+            f"parallel.protocols_sharded.{protocol}_runner",
+            chunk=ci, chunks_total=len(chunks),
+            ticks_done=horizon_ticks * (ci + 1), digest_head=digest_head,
+        )
         received += np.asarray(r, dtype=np.int64).sum(axis=0)
         sent += bitmask.combine_u64(
             jnp.asarray(s_lo), jnp.asarray(s_hi)

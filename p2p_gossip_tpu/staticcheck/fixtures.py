@@ -23,11 +23,16 @@ Fixtures:
              always-on instrumentation that would silently break the
              zero-cost contract (telemetry_off.py must flag the ring
              avals in the supposedly-off trace)
+  digest     state-digest ring forced on with the telemetry flag down —
+             same zero-cost contract, separate detection channel: the
+             digest ring is rank-1, so telemetry_off.py's T4 rule greps
+             the OFF trace for the digest mix constants instead of
+             scanning aval shapes
 """
 
 from __future__ import annotations
 
-FIXTURES = ("f64", "recompile", "prng", "telemetry")
+FIXTURES = ("f64", "recompile", "prng", "telemetry", "digest")
 
 
 def f64_fixture() -> dict:
@@ -155,6 +160,31 @@ def telemetry_fixture() -> dict:
     }
 
 
+def digest_fixture() -> dict:
+    """Force the state-digest ring on while the telemetry flag is down
+    (the `digest._FIXTURE_FORCE` backdoor) and run the zero-cost check on
+    one instrumented kernel: the T4 rule must find the digest mix
+    constants in the telemetry-OFF trace."""
+    import jax
+
+    from p2p_gossip_tpu.staticcheck.telemetry_off import run_telemetry_check
+    from p2p_gossip_tpu.telemetry import digest
+
+    digest._FIXTURE_FORCE = True
+    # Same cache discipline as telemetry_fixture, both edges.
+    jax.clear_caches()
+    try:
+        report = run_telemetry_check(only=("engine.sync._run_chunk_while",))
+    finally:
+        digest._FIXTURE_FORCE = False
+        jax.clear_caches()
+    return {
+        "fixture": "digest",
+        "ok": report["ok"],  # must come back False
+        "violations": report["violations"],
+    }
+
+
 def run_fixture(name: str) -> dict:
     if name == "f64":
         return f64_fixture()
@@ -164,4 +194,6 @@ def run_fixture(name: str) -> dict:
         return prng_fixture()
     if name == "telemetry":
         return telemetry_fixture()
+    if name == "digest":
+        return digest_fixture()
     raise ValueError(f"unknown fixture {name!r}; valid: {FIXTURES}")

@@ -18,6 +18,14 @@ That claim is enforced here, not asserted in a docstring:
                         string-identical jaxpr to the default call —
                         existing call sites (which pass nothing) are on
                         the off path.
+  T4 no-digest-when-off the OFF trace contains no state-digest math.
+                        The digest ring is rank-1 (no shape signature to
+                        scan for), so the rule greps the trace for the
+                        digest's mix constants (telemetry/digest.py
+                        keeps them unique in the codebase — lowbias32,
+                        not the murmur3 family the counter-hash coins
+                        use), both as inline literals in the jaxpr text
+                        and as hoisted scalar uint32 consts.
 
 Instrumented surfaces are discovered from the audit registry by naming
 convention: every ``<name>[telemetry]`` entry is the ON form of
@@ -27,11 +35,13 @@ automatically.
 The ``telemetry`` regression fixture (scripts/staticcheck.py --fixture
 telemetry) forces the rings on via `telemetry.rings._FIXTURE_FORCE` and
 asserts T1 flags it — proving the checker still catches an always-on
-ring.
+ring. The ``digest`` fixture does the same through
+`telemetry.digest._FIXTURE_FORCE` for T4.
 """
 
 from __future__ import annotations
 
+import re
 import traceback
 
 from p2p_gossip_tpu.staticcheck.jaxpr_audit import Violation, _avals_of
@@ -62,6 +72,33 @@ def _ring_avals(closed) -> list[tuple]:
             and shape not in found
         ):
             found.append(shape)
+    return found
+
+
+def _digest_leaks(closed) -> list[str]:
+    """Evidence of digest math in a trace: the mix constants, inline in
+    the jaxpr text or hoisted into scalar uint32 consts. Word-boundary
+    match — the decimal digits must form a whole literal."""
+    import numpy as np
+
+    from p2p_gossip_tpu.telemetry.digest import MIX_M1, MIX_M2
+
+    found = []
+    text = str(closed)
+    for c in (MIX_M1, MIX_M2):
+        if re.search(rf"\b{c}\b", text):
+            found.append(f"inline literal {c} (0x{c:08X})")
+    for cv in getattr(closed, "consts", ()):
+        try:
+            arr = np.asarray(cv)
+        except Exception:
+            continue
+        if (
+            arr.dtype == np.uint32
+            and arr.ndim == 0
+            and int(arr) in (MIX_M1, MIX_M2)
+        ):
+            found.append(f"hoisted uint32 const {int(arr)}")
     return found
 
 
@@ -114,6 +151,17 @@ def check_pair(base, on_entry) -> list[Violation]:
             f"telemetry-OFF trace carries metric-ring avals {rings_off} — "
             "the rings must compile away when disabled (zero-cost "
             "contract, docs/OBSERVABILITY.md)",
+        ))
+
+    # T4 — the off program carries no digest math.
+    leaks = _digest_leaks(off_jaxpr)
+    if leaks:
+        violations.append(Violation(
+            base.name, "digest-off-clean",
+            f"telemetry-OFF trace contains digest mix constants "
+            f"({'; '.join(leaks)}) — the state-digest ring must compile "
+            "away when disabled (zero-cost contract, "
+            "docs/OBSERVABILITY.md)",
         ))
 
     # T2 — the flag actually instruments.

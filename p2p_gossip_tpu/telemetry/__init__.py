@@ -7,8 +7,11 @@ When off, the device rings compile away (same jaxpr — enforced by
 
 Layout: `schema` (event contract, jax-free), `sink` (the stream),
 `spans` (host phase timers), `rings` (device per-tick aggregates),
-`chrometrace` (Perfetto/chrome://tracing export). Reports:
-`scripts/run_report.py`. Docs: docs/OBSERVABILITY.md.
+`digest` (per-tick state digests — the flight recorder), `progress`
+(per-chunk liveness beats + heartbeat file), `compare` (digest-stream
+alignment for the divergence bisector), `chrometrace`
+(Perfetto/chrome://tracing export). Reports: `scripts/run_report.py`,
+`scripts/divergence.py`. Docs: docs/OBSERVABILITY.md.
 """
 
 from p2p_gossip_tpu.telemetry.schema import (  # noqa: F401
@@ -33,4 +36,13 @@ from p2p_gossip_tpu.telemetry.spans import (  # noqa: F401
     emit_counter,
     emit_jit_cache_counters,
     span,
+)
+from p2p_gossip_tpu.telemetry.progress import (  # noqa: F401
+    configure_heartbeat,
+    emit_progress,
+    heartbeat_age_s,
+    heartbeat_path,
+    is_stale,
+    read_heartbeat,
+    write_heartbeat,
 )

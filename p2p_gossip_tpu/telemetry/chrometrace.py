@@ -11,6 +11,12 @@ Two timelines, two pids:
   microsecond timestamp. Ticks have no wall-clock identity (they run
   inside one jit), so the device timeline is in simulation time; the
   enclosing chunk span on pid 1 says what wall interval it maps to.
+  Digest streams ride pid 2 the same way; progress beats land on pid 1
+  as instant events at their wall offset.
+
+A stream need not carry every event type — a spans-only stream (bench
+runs keep device rings off) exports just the host timeline, and a
+rings-only stream just the device one.
 
 Round-trip helpers (`spans_from_chrome`) exist so the export is
 testable without a browser.
@@ -61,6 +67,43 @@ def to_chrome_trace(events) -> dict:
                         "ts": t0 + i,
                         "args": {col: val},
                     })
+        elif etype == "digest":
+            # Flight-recorder stream on the device timeline: the raw
+            # uint32 per tick. The numeric value is a hash (only
+            # equality means anything), but two runs' traces overlay to
+            # a visual divergence point.
+            label = event["kernel"]
+            for key in ("chunk", "replica", "shard"):
+                if key in event:
+                    label += f"[{key}={event[key]}]"
+            t0 = int(event.get("t0", 0))
+            for i, val in enumerate(event.get("values", [])):
+                trace.append({
+                    "ph": "C",
+                    "pid": 2,
+                    "name": f"digest:{label}",
+                    "ts": t0 + i,
+                    "args": {"digest": val},
+                })
+        elif etype == "progress":
+            # Liveness beats as instant events on the host timeline at
+            # their wall offset — the gaps between them are the stall
+            # detector's raw signal, visible at a glance.
+            args = {
+                k: event[k]
+                for k in ("chunk", "chunks_total", "ticks_done",
+                          "coverage_pct", "eta_s", "digest_head")
+                if k in event
+            }
+            trace.append({
+                "ph": "i",
+                "s": "g",
+                "pid": 1,
+                "tid": 1,
+                "name": f"progress:{event.get('kernel', '?')}",
+                "ts": round(float(event.get("elapsed_s", 0.0)) * 1e6, 3),
+                "args": args,
+            })
         elif etype == "counter":
             trace.append({
                 "ph": "C",

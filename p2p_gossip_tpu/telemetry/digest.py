@@ -1,0 +1,300 @@
+"""Per-tick state digests — the flight recorder's black-box stream.
+
+A digest is one uint32 per simulated tick summarizing the engine's full
+state: every packed seen-bitmask word and every per-node counter is
+salted (by node id, word index, and counter kind), passed through a
+32-bit integer mix, and XOR-folded. XOR is associative and commutative,
+so the fold order never matters — a vmapped replica lane, a shard_map
+node shard (partial XOR per shard, one collective to combine), and the
+solo engine all produce bit-identical digests for the same state. That
+is the property the divergence bisector (`scripts/divergence.py`)
+leans on: two engines that agree tick-for-tick produce identical
+digest streams, and the first differing index IS the first divergent
+tick.
+
+The device digest ring rides the same STATIC ``telemetry`` flag as the
+metric rings (`telemetry/rings.py`): one extra ``(capacity,)`` uint32
+loop-carry plus one trailing output when the flag is up, nothing at all
+when it is down. `staticcheck/telemetry_off.py` enforces the off side
+by scanning the OFF trace for this module's mix constants — they appear
+nowhere else in the codebase (the counter-hash coins use the murmur3
+family; the digest deliberately uses lowbias32/squirrel3 constants), so
+a single leaked digest op is detectable from the jaxpr alone.
+
+A bit-exact numpy twin (`tick_digest_np`) lets host engines join the
+same stream: the event engine's per-tick state (`engine/event.py`
+``on_tick`` hook) digests to the same values as the sync kernel when
+the two engines agree — the native-vs-sync leg of the bisector.
+
+Digest semantics (what is folded, per tick, after the tick's updates):
+
+- every seen word ``seen[i, k]`` salted with global node id i and
+  chunk-local word index k;
+- ``received[i]`` (per-node first-time receives, chunk-local);
+- ``sent`` — the uint32 low word, plus the high word when the engine
+  carries an emulated-u64 pair (the partnered protocols). Flood
+  engines fold the low word only; their host twins must do the same.
+
+The fold is SPARSE: an entry whose value is zero contributes nothing
+(rather than mix(0 ^ salts)). That makes the digest invariant to pad
+width — the campaign engine word-rounds shares to a 32-lane chunk
+while the solo engine pads to its 4096-share chunk, the sharded
+runners pad the node axis to the mesh — so engines with different pad
+shapes produce bit-identical digests for identical live state. (It
+also means sent_hi == 0 folds like an absent high word, so a
+protocol engine that never overflowed its low sent word digests
+compatibly with a flood-style lo-only fold of the same counters.)
+
+Cross-engine comparisons are per share-chunk: the solo engine digests
+each chunk's state separately (one stream per chunk), the sharded
+runners digest each share-shard's pass (shard k == chunk k), and the
+campaign engine digests each replica lane (replica r == the solo run
+seeded with seed r).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from p2p_gossip_tpu.telemetry import sink
+
+# Mix constants (lowbias32: Ellis, "Prospecting for Hash Functions") and
+# lane salts (squirrel3 noise constants). These values are the digest's
+# jaxpr signature — staticcheck's telemetry_off rule greps OFF traces
+# for them — so they must stay unique to this module: the counter-hash
+# coins (models/partnersel.py, models/linkloss.py, native/) use the
+# murmur3 constant family instead.
+MIX_M1 = 0x21F0AAAD
+MIX_M2 = 0xD35A2D97
+SALT_NODE = 0xB5297A4D
+SALT_WORD = 0x68E31DA4
+SALT_RECV = 0x1B56C4E9
+SALT_SENT_LO = 0x7F4A7C15
+SALT_SENT_HI = 0x94D049BB
+
+#: Test fixture hook (`scripts/staticcheck.py --fixture digest`): forces
+#: the digest computation on behind a down telemetry flag, which the
+#: telemetry_off digest rule must flag. Never set in production.
+_FIXTURE_FORCE = False
+
+
+def active(telemetry) -> bool:
+    """Whether kernels should carry the digest ring for this STATIC
+    telemetry flag value. Mirrors `rings.active` but consults this
+    module's own fixture hook, so the digest leak check can be proven
+    to bite independently of the metric-ring leak check."""
+    return bool(telemetry) or _FIXTURE_FORCE
+
+
+def _mix_jnp(x):
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(MIX_M1)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(MIX_M2)
+    x = x ^ (x >> 15)
+    return x
+
+
+def _xor_all_jnp(x):
+    return lax.reduce(
+        x, jnp.uint32(0), lax.bitwise_xor, tuple(range(x.ndim))
+    )
+
+
+def _fold_sparse_jnp(values, salted):
+    """XOR-fold mix(salted), skipping entries whose VALUE is zero — the
+    pad-invariance rule (see module docstring)."""
+    return _xor_all_jnp(
+        jnp.where(
+            values.astype(jnp.uint32) == 0, jnp.uint32(0), _mix_jnp(salted)
+        )
+    )
+
+
+def tick_digest(seen, received, sent_lo, sent_hi=None, node_ids=None):
+    """uint32 scalar digest of one tick's state (device side).
+
+    ``seen`` (n, w) uint32; ``received``/``sent_lo``/``sent_hi`` (n,)
+    integer arrays (any int dtype; folded as their uint32 bit pattern).
+    ``node_ids`` are GLOBAL node ids (default 0..n-1) — sharded callers
+    pass their row offset so every mesh shape folds identical salts.
+    Omit ``sent_hi`` for engines carrying a plain int32 sent counter
+    (flood); pass it for the emulated-u64 pairs (partnered protocols) —
+    the sparse fold makes the two conventions agree while the high
+    word is zero.
+    """
+    n, w = seen.shape
+    if node_ids is None:
+        node_ids = jnp.arange(n, dtype=jnp.uint32)
+    node_salt = node_ids.astype(jnp.uint32) * jnp.uint32(SALT_NODE)
+    word_salt = jnp.arange(w, dtype=jnp.uint32) * jnp.uint32(SALT_WORD)
+    seen = seen.astype(jnp.uint32)
+    received = received.astype(jnp.uint32)
+    sent_lo = sent_lo.astype(jnp.uint32)
+    h = _fold_sparse_jnp(
+        seen, seen ^ word_salt[None, :] ^ node_salt[:, None]
+    )
+    h = h ^ _fold_sparse_jnp(
+        received, received ^ node_salt ^ jnp.uint32(SALT_RECV)
+    )
+    h = h ^ _fold_sparse_jnp(
+        sent_lo, sent_lo ^ node_salt ^ jnp.uint32(SALT_SENT_LO)
+    )
+    if sent_hi is not None:
+        sent_hi = sent_hi.astype(jnp.uint32)
+        h = h ^ _fold_sparse_jnp(
+            sent_hi, sent_hi ^ node_salt ^ jnp.uint32(SALT_SENT_HI)
+        )
+    return h
+
+
+def tick_digest_sharded(
+    seen, received, sent_lo, node_ids, axis_name, sent_hi=None
+):
+    """Shard-local partial digest XOR-combined over the node axis.
+
+    Inside shard_map each node shard folds its own rows (with GLOBAL
+    node ids), then one ``all_gather`` of the (1,)-scalar partials plus
+    a local XOR fold combines them — `lax.psum` sums, it cannot XOR.
+    Bitwise equal to the single-device `tick_digest` over the full node
+    set because XOR is order-independent."""
+    part = tick_digest(
+        seen, received, sent_lo, sent_hi=sent_hi, node_ids=node_ids
+    )
+    parts = lax.all_gather(part, axis_name)
+    return _xor_all_jnp(parts)
+
+
+# --- ring carry helpers (mirror telemetry/rings.py) -----------------------
+
+def init(capacity: int):
+    """Fresh (capacity,) uint32 digest ring for a loop carry."""
+    return jnp.zeros((capacity,), dtype=jnp.uint32)
+
+
+def init_batched(batch: int, capacity: int):
+    """(batch, capacity) ring for vmapped campaign kernels — one digest
+    lane per replica."""
+    return jnp.zeros((batch, capacity), dtype=jnp.uint32)
+
+
+def write(ring, t, value):
+    """ring with ``value`` stored at tick ``t`` (traced index)."""
+    return lax.dynamic_update_slice(
+        ring, value[None].astype(jnp.uint32), (t,)
+    )
+
+
+def write_batched(ring, t, values):
+    """(B, cap) ring with the (B,) ``values`` stored at tick ``t``."""
+    return lax.dynamic_update_slice(
+        ring, values[:, None].astype(jnp.uint32), (jnp.int32(0), t)
+    )
+
+
+# --- host (numpy) twin ----------------------------------------------------
+
+def _mix_np(x: np.ndarray) -> np.ndarray:
+    x = x.astype(np.uint32)
+    with np.errstate(over="ignore"):
+        x = x ^ (x >> np.uint32(16))
+        x = x * np.uint32(MIX_M1)
+        x = x ^ (x >> np.uint32(15))
+        x = x * np.uint32(MIX_M2)
+        x = x ^ (x >> np.uint32(15))
+    return x
+
+
+def tick_digest_np(
+    seen_words: np.ndarray,
+    received: np.ndarray,
+    sent_lo: np.ndarray,
+    sent_hi: np.ndarray | None = None,
+    node_ids: np.ndarray | None = None,
+) -> int:
+    """Bit-exact numpy twin of `tick_digest` for host engines (the event
+    engine's per-tick hook) and for unit tests pinning the device
+    implementation."""
+    n, _w = seen_words.shape
+    if node_ids is None:
+        node_ids = np.arange(n, dtype=np.uint32)
+
+    def fold_sparse(values, salted):
+        return np.bitwise_xor.reduce(
+            np.where(
+                values.astype(np.uint32) == 0,
+                np.uint32(0),
+                _mix_np(salted),
+            ),
+            axis=None,
+        )
+
+    with np.errstate(over="ignore"):
+        node_salt = node_ids.astype(np.uint32) * np.uint32(SALT_NODE)
+        word_salt = (
+            np.arange(seen_words.shape[1], dtype=np.uint32)
+            * np.uint32(SALT_WORD)
+        )
+        seen_words = seen_words.astype(np.uint32)
+        received = received.astype(np.uint32)
+        sent_lo = sent_lo.astype(np.uint32)
+        h = fold_sparse(
+            seen_words,
+            seen_words ^ word_salt[None, :] ^ node_salt[:, None],
+        )
+        h ^= fold_sparse(
+            received, received ^ node_salt ^ np.uint32(SALT_RECV)
+        )
+        h ^= fold_sparse(
+            sent_lo, sent_lo ^ node_salt ^ np.uint32(SALT_SENT_LO)
+        )
+        if sent_hi is not None:
+            sent_hi = sent_hi.astype(np.uint32)
+            h ^= fold_sparse(
+                sent_hi, sent_hi ^ node_salt ^ np.uint32(SALT_SENT_HI)
+            )
+    return int(h)
+
+
+def pack_seen_np(member: np.ndarray, num_words: int) -> np.ndarray:
+    """(n, slots) bool membership -> (n, num_words) uint32 packed words,
+    matching ops/bitmask.py's contract (slot s -> word s // 32, bit
+    s % 32) — how host engines rebuild the kernel's seen layout."""
+    n, slots = member.shape
+    out = np.zeros((n, num_words), dtype=np.uint32)
+    for s in range(slots):
+        if member[:, s].any():
+            out[:, s // 32] |= (
+                member[:, s].astype(np.uint32) << np.uint32(s % 32)
+            )
+    return out
+
+
+# --- stream emission ------------------------------------------------------
+
+def emit_digest(kernel: str, ring, *, t0: int, ticks: int, **provenance):
+    """Emit one harvested digest ring as a ``digest`` event: rows
+    [t0, t0+ticks) of the (capacity,) host copy (same slicing convention
+    as `rings.emit_ring`). No-op when device rings are disabled.
+    ``ticks`` is the executed-tick count (the while kernels stop at
+    quiescence; rows past it were never written)."""
+    if not sink.rings_enabled():
+        return
+    values = np.asarray(ring, dtype=np.uint32)[
+        int(t0) : int(t0) + max(int(ticks), 0)
+    ]
+    event = {
+        "type": "digest",
+        "kernel": kernel,
+        "t0": int(t0),
+        "ticks": int(values.shape[0]),
+        "values": [int(v) for v in values],
+    }
+    for key, val in provenance.items():
+        if val is not None:
+            event[key] = val
+    sink.emit(event)

@@ -14,11 +14,19 @@ and the traced jaxpr is byte-identical to the pre-telemetry program —
 ``telemetry`` regression fixture (`_FIXTURE_FORCE`) proves the check
 still catches an always-on ring.
 
-Overflow bound: rows are uint32, so any per-tick aggregate >= 2^32
-wraps. The largest is ``or_work`` <= (frontier nodes) x dmax and
-``frontier_bits`` <= N x chunk_size; at the 1M-node ladder's telemetry
-shapes (chunk 64) the bound is ~6.4e7 — 64x headroom. Full-width 1M
-chunks (W=128) CAN exceed it; docs/OBSERVABILITY.md documents the wrap.
+Overflow bound: rows are uint32, so a per-tick aggregate >= 2^32 cannot
+be represented. The largest is ``or_work`` <= (frontier nodes) x dmax
+and ``frontier_bits`` <= N x chunk_size; at the 1M-node ladder's
+telemetry shapes (chunk 64) the bound is ~6.4e7 — 64x headroom.
+Full-width 1M chunks (W=128) CAN exceed it; `u32sum` therefore
+SATURATES at 2^32 - 1 instead of wrapping (exact for up to 2^24
+summands — 16x the 1M node axis), so an overflowed aggregate reads as
+the unmistakable sentinel 4294967295 rather than a small garbage value,
+and `scripts/run_report.py` prints a wrap warning when a row saturates.
+The one remaining modular edge: the sharded runners `psum` per-shard
+rows, and the psum itself is plain mod-2^32 addition — a row can only
+saturate per shard, so a mesh-wide aggregate between ~2^32 and
+shards x 2^32 still wraps unless some shard's partial saturated first.
 """
 
 from __future__ import annotations
@@ -62,9 +70,35 @@ def write_batched(ring: jnp.ndarray, t, rows: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.dynamic_update_slice(ring, rows[:, None, :], (0, t, 0))
 
 
+#: uint32 saturation sentinel: an aggregate that could not be
+#: represented reads as exactly this value (run_report warns on it).
+U32_MAX = 0xFFFFFFFF
+
+
 def u32sum(x) -> jnp.ndarray:
-    """Modular-uint32 total of an integer array (the documented wrap)."""
-    return jnp.sum(x.astype(jnp.uint32))
+    """Saturating-uint32 total of an integer array.
+
+    The sum is computed exactly via four byte-limb reductions (each limb
+    total stays below 2^32 for up to 2^24 summands — 16x the 1M node
+    axis) and recombined with explicit carries; any carry out of the low
+    word clamps the result to ``U32_MAX``. x64 stays off on device (the
+    J1 staticcheck rule), so this is the widest exact sum uint32 admits.
+    """
+    x = x.astype(jnp.uint32).reshape(-1)
+    limbs = [
+        jnp.sum((x >> shift) & jnp.uint32(0xFF), dtype=jnp.uint32)
+        for shift in (0, 8, 16, 24)
+    ]
+
+    def add_carry(lo, hi, add):
+        new_lo = lo + add
+        return new_lo, hi + (new_lo < add).astype(jnp.uint32)
+
+    lo, hi = limbs[0], jnp.uint32(0)
+    for i, limb in enumerate(limbs[1:], start=1):
+        lo, hi = add_carry(lo, hi, limb << jnp.uint32(8 * i))
+        hi = hi + (limb >> jnp.uint32(32 - 8 * i))
+    return jnp.where(hi > 0, jnp.uint32(U32_MAX), lo)
 
 
 def total_bits(words: jnp.ndarray) -> jnp.ndarray:

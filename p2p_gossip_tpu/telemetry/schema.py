@@ -20,7 +20,18 @@ Event types (SCHEMA_VERSION 1):
            "metrics": {column: [per-tick ints]}} plus optional
            provenance ("chunk", "replica", "seed", "shard").
   counter  a scalar sample: {"type": "counter", "name", "value"} —
-           used for the PR-3 recompile-sentinel jit-cache sizes.
+           used for the PR-3 recompile-sentinel jit-cache sizes and the
+           compiled-cost observatory (``cost.<entry>.<field>`` names,
+           scripts/cost_report.py).
+  digest   one harvested per-tick state-digest ring (telemetry/digest.py):
+           {"type": "digest", "kernel", "t0", "ticks",
+           "values": [uint32 per executed tick]} plus the same optional
+           provenance keys as ring events — the flight-recorder stream
+           the divergence bisector aligns.
+  progress one per-chunk liveness beat (telemetry/progress.py):
+           {"type": "progress", "kernel", "elapsed_s"} plus optional
+           "chunk", "chunks_total", "ticks_done", "coverage_pct",
+           "eta_s", "digest_head" (8-hex-digit string).
 
 Ring columns (uint32 on device — see docs/OBSERVABILITY.md for the
 per-engine semantics and the overflow bound):
@@ -54,7 +65,7 @@ METRIC_COLUMNS = (
 )
 NUM_METRICS = len(METRIC_COLUMNS)
 
-EVENT_TYPES = ("meta", "span", "ring", "counter")
+EVENT_TYPES = ("meta", "span", "ring", "counter", "digest", "progress")
 
 
 def validate_event(event) -> list[str]:
@@ -117,6 +128,46 @@ def validate_event(event) -> list[str]:
                     errs.append(
                         f"ring.metrics.{col} must hold non-negative ints"
                     )
+    elif etype == "digest":
+        if not isinstance(event.get("kernel"), str) or not event.get("kernel"):
+            errs.append("digest.kernel must be a non-empty string")
+        ticks = event.get("ticks")
+        if not isinstance(ticks, int) or ticks < 0:
+            errs.append("digest.ticks must be an int >= 0")
+        if not isinstance(event.get("t0"), int) or event.get("t0", -1) < 0:
+            errs.append("digest.t0 must be an int >= 0")
+        values = event.get("values")
+        if not isinstance(values, list):
+            errs.append("digest.values must be a list")
+        else:
+            if isinstance(ticks, int) and len(values) != ticks:
+                errs.append(
+                    f"digest.values has {len(values)} entries, ticks "
+                    f"says {ticks}"
+                )
+            if not all(
+                isinstance(v, int) and 0 <= v < (1 << 32) for v in values
+            ):
+                errs.append("digest.values must hold uint32 ints")
+    elif etype == "progress":
+        if not isinstance(event.get("kernel"), str) or not event.get("kernel"):
+            errs.append("progress.kernel must be a non-empty string")
+        val = event.get("elapsed_s")
+        if not isinstance(val, (int, float)) or val < 0:
+            errs.append("progress.elapsed_s must be a number >= 0")
+        for key in ("chunk", "chunks_total", "ticks_done"):
+            if key in event and (
+                not isinstance(event[key], int) or event[key] < 0
+            ):
+                errs.append(f"progress.{key} must be an int >= 0")
+        for key in ("coverage_pct", "eta_s"):
+            if key in event and not isinstance(event[key], (int, float)):
+                errs.append(f"progress.{key} must be a number")
+        if "digest_head" in event and not (
+            isinstance(event["digest_head"], str)
+            and len(event["digest_head"]) == 8
+        ):
+            errs.append("progress.digest_head must be an 8-hex-char string")
     elif etype == "counter":
         if not isinstance(event.get("name"), str) or not event.get("name"):
             errs.append("counter.name must be a non-empty string")

@@ -41,7 +41,7 @@ _rings = False
 _path: str | None = None
 _file = None
 _buffer: list[dict] = []
-_epoch = 0.0                 # monotonic origin for span timestamps
+_epoch = time.perf_counter()  # monotonic origin for span timestamps
 _event_count = 0
 
 
@@ -111,7 +111,9 @@ def rings_enabled() -> bool:
 
 
 def epoch() -> float:
-    """Monotonic origin for span timestamps (perf_counter units)."""
+    """Monotonic origin for span timestamps (perf_counter units). Before
+    any configure() the module-import instant stands in, so heartbeat-
+    only runs (sink never configured) still report a sane elapsed_s."""
     return _epoch
 
 

@@ -278,6 +278,14 @@ def build_parser() -> argparse.ArgumentParser:
         "uninstrumented kernels. Render with scripts/run_report.py",
     )
     p.add_argument(
+        "--heartbeat", type=str, default="", metavar="PATH",
+        help="Atomically rewrite this liveness file on every chunk "
+        "boundary (telemetry/progress.py): last chunk index, ticks "
+        "done, coverage %%, digest head. Works with telemetry off — "
+        "watchers read the file's mtime age to tell a long run from a "
+        "hang. Also honors P2P_HEARTBEAT=<path>",
+    )
+    p.add_argument(
         "--graphFile", type=str, default="",
         help="npz graph cache: load the topology from this file if it "
         "exists, else build per --topology and save it — graph builds "
@@ -643,6 +651,10 @@ def run(argv=None) -> int:
         except OSError as e:
             print(f"error: --telemetry: {e}", file=sys.stderr)
             return 2
+    if args.heartbeat:
+        # Explicit flag wins over P2P_HEARTBEAT (same precedence rule as
+        # --telemetry above).
+        telemetry.configure_heartbeat(args.heartbeat)
     horizon = int(round(args.simTime / tick_dt))
 
     if args.sweep:

@@ -302,27 +302,48 @@ def main() -> int:
                 candidates.append(os.path.join(
                     REPO, cap.replace(".xplane.pb.gz", "_summary.json")
                 ))
-            from_file = False
+            # Track WHY the standalone summary lost so the caveat can
+            # say the true reason (round-5 advisor: "not found" was
+            # also printed for unreadable/wrong-shape files).
+            from_file = None
+            fallback_why = "no candidate paths (summary has no stamp "\
+                "and no committed capture)"
             for spath in candidates:
-                if os.path.exists(spath):
-                    try:
-                        with open(spath) as f:
-                            loaded = json.load(f)
-                    except (OSError, ValueError):
-                        continue
-                    # Valid JSON that isn't a summary dict (hand-edited,
-                    # future list-of-summaries writer) must fall back,
-                    # not crash md_table.
-                    if isinstance(loaded, dict):
-                        s = loaded
-                        from_file = True
-                        break
+                if not os.path.exists(spath):
+                    fallback_why = "standalone summary JSON not found"
+                    continue
+                try:
+                    with open(spath) as f:
+                        loaded = json.load(f)
+                except (OSError, ValueError):
+                    fallback_why = (
+                        f"standalone summary not readable as JSON "
+                        f"({os.path.basename(spath)})"
+                    )
+                    continue
+                # Valid JSON that isn't a summary dict (hand-edited,
+                # future list-of-summaries writer) must fall back,
+                # not crash md_table.
+                if isinstance(loaded, dict):
+                    s = loaded
+                    from_file = spath
+                    break
+                fallback_why = (
+                    f"standalone summary is not a summary object "
+                    f"({os.path.basename(spath)})"
+                )
             print("## Profiler calibration (measured vs modeled HBM)\n")
-            if not from_file:
+            if from_file:
+                # Provenance marker: a corrected offline reparse must be
+                # distinguishable from the battery-time parse by more
+                # than the absence of a caveat.
+                print("(corrected standalone summary: "
+                      f"{os.path.relpath(from_file, REPO)})\n")
+            else:
                 print(
-                    "(battery-time parse — standalone summary JSON not "
-                    "found; sums may predate offline corrections, e.g. "
-                    "the 2026-08-01 2x row-double-count fix)\n"
+                    f"(battery-time parse — {fallback_why}; sums may "
+                    "predate offline corrections, e.g. the 2026-08-01 "
+                    "2x row-double-count fix)\n"
                 )
             print(md_table([s], [
                 "bench_metric",
@@ -337,6 +358,60 @@ def main() -> int:
                     " (capture committed for offline re-parse)"
                     if s.get("capture") else " (no capture committed)"
                 ))
+            print()
+
+    flightrec = by_stage.get("flightrec")
+    if flightrec and flightrec["results"]:
+        div = next(
+            (r for r in reversed(flightrec["results"])
+             if r.get("mode") in ("compare", "inject-fault")),
+            None,
+        )
+        cost = next(
+            (r for r in reversed(flightrec["results"])
+             if "entries_costed" in r),
+            None,
+        )
+        print("## Flight recorder (digest parity + compiled-cost "
+              "ledger)\n")
+        if div:
+            print(md_table([
+                {
+                    "pair": p.get("pair"),
+                    "result": (
+                        p.get("skipped") and f"skipped: {p['skipped']}"
+                        or ("fault@{} -> {}".format(
+                            p.get("fault_tick"), p.get("located_tick"))
+                            if "fault_located" in p else
+                            ("DIVERGED @ t=" + str(p.get("tick"))
+                             if p.get("diverged") else "clean"))
+                    ),
+                    "ticks_compared": p.get("compared"),
+                }
+                for p in div.get("pairs", [])
+            ], ["pair", "result", "ticks_compared"]))
+            print(f"\nbisector {'OK' if div.get('ok') else 'FAIL'} "
+                  f"(mode: {div.get('mode')})\n")
+        if cost:
+            top = sorted(
+                (e for e in cost.get("entries", []) if e.get("ok")),
+                key=lambda e: -(e.get("flops") or 0),
+            )[:8]
+            print(f"compiled-cost ledger on {cost.get('platform')} "
+                  f"({cost.get('entries_costed')} entries, "
+                  f"{cost.get('total_compile_wall_s')}s total "
+                  "compile):\n")
+            print(md_table([
+                {
+                    "entry": e["entry"],
+                    "flops": e.get("flops"),
+                    "bytes_accessed": e.get("bytes_accessed"),
+                    "jaxpr_eqns": e.get("jaxpr_eqns"),
+                    "compile_s": e.get("compile_wall_s"),
+                }
+                for e in top
+            ], ["entry", "flops", "bytes_accessed", "jaxpr_eqns",
+                "compile_s"]))
             print()
 
     for stage, title in (

@@ -35,6 +35,26 @@ if ! JAX_PLATFORMS=cpu python scripts/run_report.py --capture-smoke \
   exit 1
 fi
 
+# Flight-recorder smoke: the smallest engine pair (native event engine
+# vs the compiled sync kernel) on a tiny seeded workload must agree
+# digest-for-digest (clean bisection), and the bisector's fault
+# injection must name the injected tick exactly — a bisector blind to
+# divergence would otherwise stay green forever (scripts/divergence.py).
+if ! JAX_PLATFORMS=cpu python scripts/divergence.py --pair native-sync \
+    --n 64 --shares 3 --horizon 16 --json > /tmp/_t1_divergence.json; then
+  echo "ci_tier1: FAIL — divergence smoke (see /tmp/_t1_divergence.json;" \
+       "run 'python scripts/divergence.py --pair native-sync' to" \
+       "reproduce)" >&2
+  exit 1
+fi
+if ! JAX_PLATFORMS=cpu python scripts/divergence.py --pair native-sync \
+    --n 64 --shares 3 --horizon 16 --inject-fault 4 --json \
+    > /tmp/_t1_divergence_fault.json; then
+  echo "ci_tier1: FAIL — divergence fault-injection self-test (see" \
+       "/tmp/_t1_divergence_fault.json)" >&2
+  exit 1
+fi
+
 # Marker registration check: `pytest --markers` must list `slow`.
 if ! JAX_PLATFORMS=cpu python -m pytest --markers -p no:cacheprovider 2>/dev/null \
     | grep -q "^@pytest.mark.slow:"; then

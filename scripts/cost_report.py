@@ -1,0 +1,198 @@
+"""Compiled-cost observatory — flops, bytes, compile time per entry point.
+
+    python scripts/cost_report.py                  # human table
+    python scripts/cost_report.py --json           # one JSON line on stdout
+    python scripts/cost_report.py --only engine.sync
+    P2P_TELEMETRY=run.jsonl python scripts/cost_report.py   # + counter events
+
+Lowers and compiles every staticcheck-registered entry point on the
+default device and harvests what XLA already knows but nobody looks at:
+``cost_analysis()`` flops and bytes-accessed, ``memory_analysis()``
+temp/argument/output footprints, compile wall time, and jaxpr equation
+count (via the auditor's ``iter_eqns`` — the same walk the invariant
+rules use). The result is the per-kernel cost ledger: a refactor that
+doubles an entry's flops or compile time shows up as a diff in this
+report before it shows up as a slow campaign.
+
+When the telemetry sink is enabled each figure is also emitted as a
+``counter`` event named ``cost.<entry>.<field>``, so a run report
+(scripts/run_report.py) carries the cost ledger of the binary that
+produced it. bench.py embeds the ``--only engine.sync --json`` output
+as its ``cost`` field. Platform is labeled — CPU figures are CPU
+figures, not chip numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+COST_FIELDS = (
+    "flops", "bytes_accessed", "compile_wall_s", "jaxpr_eqns",
+    "temp_bytes", "argument_bytes", "output_bytes",
+)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _setup_backend() -> None:
+    from p2p_gossip_tpu.utils.platform import (
+        cpu_requested,
+        force_cpu_backend_if_requested,
+    )
+
+    if cpu_requested():
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    force_cpu_backend_if_requested()
+
+
+def _cost_dict(compiled) -> dict:
+    """Flops/bytes out of ``cost_analysis()`` — tolerates both the
+    list-of-dicts and plain-dict shapes across jax versions, and
+    backends that return nothing."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {}
+    out = {}
+    if "flops" in ca:
+        out["flops"] = float(ca["flops"])
+    if "bytes accessed" in ca:
+        out["bytes_accessed"] = float(ca["bytes accessed"])
+    return out
+
+
+def _memory_dict(compiled) -> dict:
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return {}
+    out = {}
+    for field, attr in (
+        ("temp_bytes", "temp_size_in_bytes"),
+        ("argument_bytes", "argument_size_in_bytes"),
+        ("output_bytes", "output_size_in_bytes"),
+    ):
+        v = getattr(mem, attr, None)
+        if v is not None:
+            out[field] = int(v)
+    return out
+
+
+def cost_entry(entry) -> dict:
+    """Lower + compile one registered entry and harvest its cost row.
+    Never raises — a failing entry gets an ``error`` field."""
+    import jax
+
+    from p2p_gossip_tpu.staticcheck.jaxpr_audit import iter_eqns
+
+    row: dict = {"entry": entry.name}
+    try:
+        spec = entry.spec()
+        fn = spec.fn if spec.fn is not None else entry.fn
+        wrapped = lambda *args, _fn=fn, _kw=spec.kwargs: _fn(*args, **_kw)  # noqa: E731
+        closed = jax.make_jaxpr(wrapped)(*spec.args)
+        row["jaxpr_eqns"] = sum(1 for _ in iter_eqns(closed.jaxpr))
+        t0 = time.monotonic()
+        compiled = jax.jit(wrapped).lower(*spec.args).compile()
+        row["compile_wall_s"] = round(time.monotonic() - t0, 3)
+        row.update(_cost_dict(compiled))
+        row.update(_memory_dict(compiled))
+        row["ok"] = True
+    except Exception as e:
+        row["ok"] = False
+        row["error"] = f"{type(e).__name__}: {e}"[:500]
+    return row
+
+
+def run_cost_report(only: str | None = None) -> dict:
+    """The full ledger: one row per registered entry (filtered by the
+    ``only`` substring), counter events when the sink is on."""
+    import jax
+
+    from p2p_gossip_tpu import telemetry
+    from p2p_gossip_tpu.staticcheck import entrypoints, registry
+
+    entrypoints.load_all()
+    entries = [
+        e for e in registry.all_entries()
+        if only is None or only in e.name
+    ]
+    rows = []
+    for entry in entries:
+        row = cost_entry(entry)
+        rows.append(row)
+        if telemetry.enabled() and row.get("ok"):
+            for field in COST_FIELDS:
+                if field in row:
+                    telemetry.emit_counter(
+                        f"cost.{entry.name}.{field}", row[field]
+                    )
+        log(f"cost: {entry.name}: "
+            + (f"flops={row.get('flops', 0):.0f} "
+               f"bytes={row.get('bytes_accessed', 0):.0f} "
+               f"eqns={row.get('jaxpr_eqns', '?')} "
+               f"compile={row.get('compile_wall_s', 0):.2f}s"
+               if row.get("ok") else f"ERROR {row.get('error')}"))
+    ok = all(r.get("ok") for r in rows) and bool(rows)
+    return {
+        "ok": ok,
+        "platform": jax.devices()[0].platform,
+        "entries_costed": len(rows),
+        "total_compile_wall_s": round(
+            sum(r.get("compile_wall_s", 0.0) for r in rows), 2
+        ),
+        "entries": rows,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON line on stdout instead of the table")
+    ap.add_argument("--only", metavar="SUBSTR", default=None,
+                    help="restrict to entries whose name contains SUBSTR")
+    args = ap.parse_args()
+
+    _setup_backend()
+    report = run_cost_report(only=args.only)
+
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(f"cost report: {report['entries_costed']} entries on "
+              f"{report['platform']} "
+              f"(total compile {report['total_compile_wall_s']}s)")
+        hdr = (f"{'entry':<48} {'flops':>12} {'bytes':>12} "
+               f"{'eqns':>6} {'compile_s':>9}")
+        print(hdr)
+        print("-" * len(hdr))
+        for r in report["entries"]:
+            if not r.get("ok"):
+                print(f"{r['entry']:<48} ERROR: {r.get('error')}")
+                continue
+            print(f"{r['entry']:<48} "
+                  f"{r.get('flops', 0):>12.0f} "
+                  f"{r.get('bytes_accessed', 0):>12.0f} "
+                  f"{r.get('jaxpr_eqns', 0):>6d} "
+                  f"{r.get('compile_wall_s', 0):>9.3f}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

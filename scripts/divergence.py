@@ -1,0 +1,367 @@
+"""Cross-engine divergence bisector — compare digest streams, name the tick.
+
+    python scripts/divergence.py                     # all pairs, tiny config
+    python scripts/divergence.py --pair native-sync --n 128 --horizon 32
+    python scripts/divergence.py --inject-fault 7    # self-test: must name 7
+    python scripts/divergence.py --json              # one JSON line on stdout
+
+Runs the same seeded workload through two engine configurations, collects
+their per-tick state digests (telemetry/digest.py), and reports the first
+tick where the streams disagree (telemetry/compare.py). Because engines
+that agree produce bit-identical digests, a clean run reports zero
+divergence across every pair, and any disagreement is located exactly —
+no binary search, no second run.
+
+Pairs:
+
+  native-sync      host event engine (runtime/native's reference
+                   semantics, digested through the ``on_tick`` hook)
+                   vs the compiled ``engine.sync`` tick kernel
+  sync-campaign    solo ``engine.sync`` run vs replica 0 of a vmapped
+                   flood campaign (``batch.campaign``)
+  pushpull-campaign  solo ``models.protocols`` push-pull run vs replica
+                   0 of the vmapped protocol campaign
+  sync-sharded     solo ``engine.sync`` vs the shard_map flood runner on
+                   a 2x2 mesh (skipped when fewer than 4 devices)
+
+``--inject-fault T`` is the bisector's self-test: after collecting each
+pair it flips one bit of the second stream's digest at tick T and
+asserts the comparison names exactly T — exit 0 iff every pair locates
+the fault, making a blind bisector loudly non-zero. Without injection,
+exit 0 iff every pair is divergence-free; a real divergence additionally
+dumps a +/- ``--window`` frontier capture around the named tick
+(per-node received totals and seen counts from the host engine for
+native-sync; both streams' digest windows otherwise).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+PAIRS = ("native-sync", "sync-campaign", "pushpull-campaign", "sync-sharded")
+
+
+def _setup_backend() -> None:
+    from p2p_gossip_tpu.utils.platform import (
+        cpu_requested,
+        force_cpu_backend_if_requested,
+    )
+
+    if cpu_requested():
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    force_cpu_backend_if_requested()
+
+
+def _capture_events(run) -> list:
+    """Run ``run()`` with the telemetry sink pointed at a throwaway file
+    and hand back the captured event list."""
+    from p2p_gossip_tpu import telemetry
+
+    fd, path = tempfile.mkstemp(suffix=".jsonl", prefix="divergence_")
+    os.close(fd)
+    telemetry.configure(path, rings=True)
+    try:
+        run()
+    finally:
+        telemetry.close()
+    events = list(telemetry.events())
+    telemetry.reset()
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+    return events
+
+
+def _workload(args):
+    """The shared seeded workload: an ER graph and a staggered flood
+    schedule (three generation waves exercise the delay line)."""
+    from p2p_gossip_tpu.models.generation import Schedule
+    from p2p_gossip_tpu.models.topology import erdos_renyi
+
+    graph = erdos_renyi(args.n, args.p, seed=args.seed)
+    rng = np.random.default_rng(args.seed)
+    origins = rng.integers(0, args.n, args.shares).astype(np.int32)
+    gen = (np.arange(args.shares, dtype=np.int32) % 3) * 2
+    return graph, Schedule(graph.n, origins, gen)
+
+
+def pair_native_sync(args):
+    from p2p_gossip_tpu.engine.sync import run_sync_sim
+    from p2p_gossip_tpu.telemetry import compare
+
+    graph, sched = _workload(args)
+    cap = compare.capture_event_digests(graph, sched, args.horizon)
+    events = _capture_events(
+        lambda: run_sync_sim(graph, sched, args.horizon, chunk_size=args.chunk)
+    )
+    sync = compare.select_stream(
+        compare.digest_streams(events), kernel="engine.sync"
+    )
+    return cap.digests, sync
+
+
+def pair_sync_campaign(args):
+    from p2p_gossip_tpu.batch.campaign import (
+        flood_replicas,
+        run_coverage_campaign,
+    )
+    from p2p_gossip_tpu.engine.sync import run_sync_sim
+    from p2p_gossip_tpu.telemetry import compare
+
+    graph, _ = _workload(args)
+    reps = flood_replicas(
+        graph, args.shares, [args.seed, args.seed + 1], args.horizon
+    )
+    solo_events = _capture_events(
+        lambda: run_sync_sim(
+            graph, reps.replica_schedule(0, args.horizon), args.horizon,
+            chunk_size=args.chunk,
+        )
+    )
+    camp_events = _capture_events(
+        lambda: run_coverage_campaign(graph, reps, args.horizon)
+    )
+    solo = compare.select_stream(
+        compare.digest_streams(solo_events), kernel="engine.sync"
+    )
+    camp = compare.select_stream(
+        compare.digest_streams(camp_events), kernel="batch.campaign",
+        replica=0,
+    )
+    return solo, camp
+
+
+def pair_pushpull_campaign(args):
+    from p2p_gossip_tpu.batch.campaign import (
+        flood_replicas,
+        run_protocol_campaign,
+    )
+    from p2p_gossip_tpu.models.generation import Schedule
+    from p2p_gossip_tpu.models.protocols import run_pushpull_sim
+    from p2p_gossip_tpu.telemetry import compare
+
+    graph, _ = _workload(args)
+    reps = flood_replicas(
+        graph, args.shares, [args.seed, args.seed + 1], args.horizon
+    )
+    # The campaign's solo reference: flood-style origins from the replica
+    # seed, all generated at t=0 (batch/campaign.py's replica contract).
+    origins = (
+        np.random.default_rng(args.seed)
+        .integers(0, graph.n, args.shares)
+        .astype(np.int32)
+    )
+    sched = Schedule(graph.n, origins, np.zeros(args.shares, dtype=np.int32))
+    solo_events = _capture_events(
+        lambda: run_pushpull_sim(
+            graph, sched, args.horizon, seed=args.seed,
+            churn=reps.replica_churn(0), record_coverage=True,
+        )
+    )
+    camp_events = _capture_events(
+        lambda: run_protocol_campaign(
+            graph, reps, args.horizon, protocol="pushpull"
+        )
+    )
+    solo = compare.select_stream(
+        compare.digest_streams(solo_events), kernel="models.protocols"
+    )
+    camp = compare.select_stream(
+        compare.digest_streams(camp_events), kernel="run_protocol_campaign",
+        replica=0,
+    )
+    return solo, camp
+
+
+def pair_sync_sharded(args):
+    import jax
+
+    if len(jax.devices()) < 4:
+        return None
+    from p2p_gossip_tpu.engine.sync import run_sync_sim
+    from p2p_gossip_tpu.parallel.engine_sharded import run_sharded_sim
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+    from p2p_gossip_tpu.telemetry import compare
+
+    graph, sched = _workload(args)
+    mesh = make_mesh(2, 2)
+    solo_events = _capture_events(
+        lambda: run_sync_sim(graph, sched, args.horizon, chunk_size=args.chunk)
+    )
+    sharded_events = _capture_events(
+        lambda: run_sharded_sim(
+            graph, sched, args.horizon, mesh, chunk_size=args.chunk
+        )
+    )
+    solo = compare.select_stream(
+        compare.digest_streams(solo_events), kernel="engine.sync"
+    )
+    # Shard 0 owns the pass's first chunk_size share slots — with the
+    # whole schedule in one chunk that is the solo stream's share set.
+    sharded = compare.select_stream(
+        compare.digest_streams(sharded_events), kernel="engine_sharded",
+        shard=0,
+    )
+    return solo, sharded
+
+
+_PAIR_FNS = {
+    "native-sync": pair_native_sync,
+    "sync-campaign": pair_sync_campaign,
+    "pushpull-campaign": pair_pushpull_campaign,
+    "sync-sharded": pair_sync_sharded,
+}
+
+
+def _frontier_window(args, tick: int) -> dict:
+    """Host frontier capture around a divergent tick (native-sync)."""
+    from p2p_gossip_tpu.telemetry import compare
+
+    graph, sched = _workload(args)
+    lo = max(tick - args.window, 0)
+    hi = min(tick + args.window, args.horizon - 1)
+    cap = compare.capture_event_digests(
+        graph, sched, args.horizon, window=(lo, hi)
+    )
+    return {
+        str(t): {
+            "received_total": int(cap.received[t].sum()),
+            "seen_total": int(cap.seen_counts[t].sum()),
+            "top_received": [
+                [int(i), int(cap.received[t][i])]
+                for i in np.argsort(cap.received[t])[-5:][::-1]
+            ],
+        }
+        for t in sorted(cap.received)
+    }
+
+
+def run_pair(name: str, args) -> dict:
+    from p2p_gossip_tpu.telemetry import compare
+
+    built = _PAIR_FNS[name](args)
+    if built is None:
+        return {"pair": name, "skipped": "needs >= 4 devices"}
+    a, b = built
+    report: dict = {"pair": name}
+    if args.inject_fault is not None:
+        t = args.inject_fault
+        try:
+            faulty = compare.inject_fault(b, t, bit=args.fault_bit)
+        except ValueError as e:
+            return {**report, "fault_located": False, "error": str(e)}
+        div = compare.first_divergence(a, faulty)
+        report["fault_tick"] = t
+        report["located_tick"] = div.tick
+        report["fault_located"] = div.tick == t
+        report["compared"] = div.compared
+        return report
+    div = compare.first_divergence(a, b)
+    report.update(div.as_dict())
+    if div.diverged:
+        lo = max(div.tick - args.window, 0)
+        hi = div.tick + args.window
+        report["digest_window"] = {
+            "a": {str(t): a[t] for t in sorted(a) if lo <= t <= hi},
+            "b": {str(t): b[t] for t in sorted(b) if lo <= t <= hi},
+        }
+        if name == "native-sync":
+            report["frontier"] = _frontier_window(args, div.tick)
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--pair", choices=PAIRS, action="append",
+                    help="pair(s) to compare (default: all)")
+    ap.add_argument("--n", type=int, default=96, help="nodes")
+    ap.add_argument("--p", type=float, default=0.08, help="ER edge prob")
+    ap.add_argument("--shares", type=int, default=4)
+    ap.add_argument("--horizon", type=int, default=24)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--chunk", type=int, default=32,
+                    help="solo/sharded share-chunk size")
+    ap.add_argument("--inject-fault", type=int, default=None, metavar="T",
+                    help="self-test: flip one digest bit at tick T in each "
+                    "pair's second stream; exit 0 iff the bisector names T")
+    ap.add_argument("--fault-bit", type=int, default=0)
+    ap.add_argument("--window", type=int, default=2,
+                    help="frontier-capture radius around a divergent tick")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON line on stdout")
+    ap.add_argument("--with-cost", nargs="?", const="engine.sync",
+                    default=None, metavar="SUBSTR",
+                    help="after the pairs, also run the compiled-cost "
+                    "ledger (scripts/cost_report.py) restricted to "
+                    "SUBSTR (default engine.sync) and print its JSON "
+                    "line — the battery's flightrec stage")
+    args = ap.parse_args()
+
+    _setup_backend()
+    pairs = args.pair or list(PAIRS)
+    reports = [run_pair(name, args) for name in pairs]
+
+    if args.inject_fault is not None:
+        ok = all(
+            r.get("fault_located", True) for r in reports
+        ) and any("fault_located" in r for r in reports)
+    else:
+        ok = not any(r.get("diverged") for r in reports)
+
+    out = {"ok": ok, "mode": (
+        "inject-fault" if args.inject_fault is not None else "compare"
+    ), "pairs": reports}
+    if args.json:
+        print(json.dumps(out))
+    else:
+        for r in reports:
+            if "skipped" in r:
+                print(f"{r['pair']}: SKIPPED ({r['skipped']})")
+            elif "error" in r:
+                print(f"{r['pair']}: FAULT INJECTION FAILED — {r['error']}")
+            elif "fault_located" in r:
+                print(
+                    f"{r['pair']}: injected fault at tick "
+                    f"{r.get('fault_tick')} -> located "
+                    f"{r.get('located_tick')} "
+                    f"({'OK' if r['fault_located'] else 'MISSED'}, "
+                    f"{r.get('compared', 0)} ticks compared)"
+                )
+            elif r.get("diverged"):
+                print(
+                    f"{r['pair']}: DIVERGED at tick {r['tick']} "
+                    f"(a={r['a_value']:#010x} b={r['b_value']:#010x}, "
+                    f"{r['matched_head']} ticks agreed first)"
+                )
+            else:
+                print(
+                    f"{r['pair']}: clean — {r['compared']} common ticks, "
+                    "zero divergence"
+                )
+        print(f"divergence: {'OK' if ok else 'FAIL'}")
+
+    if args.with_cost:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from cost_report import run_cost_report
+
+        cost = run_cost_report(only=args.with_cost)
+        print(json.dumps(cost))
+        ok = ok and cost["ok"]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,16 @@ Stages (value-first within safety bands — see the note after the list):
                evidence is CPU-only, docs/RESULTS.md). Standard XLA, tiny
                extra carry — safe band, right after staticcheck compiled
                the same instrumented entries.
+  flightrec — divergence.py --json --with-cost -> the flight recorder's
+               hardware leg: every engine pair re-run with per-tick
+               state digests ON and the streams bisected (clean chip
+               runs must report zero divergence — the first cross-engine
+               bitwise-parity evidence on real hardware), plus the
+               compiled-cost ledger (XLA cost_analysis flops/bytes +
+               compile wall time) for the engine.sync entries on the
+               chip's compiler. Tiny sims + standard XLA — safe band,
+               right after telemetry validated the same instrumented
+               kernels.
   scale1m   — scale_1m.py --shares 64 --chunk 64 -> the 1M ER on-chip
                line at the minimal resident footprint (pad W=2, ~5.2 GB
                modeled = essentially the bare ELL). The full-config
@@ -116,7 +126,7 @@ ART_DIR = os.path.join(REPO, "docs", "artifacts")
 
 STAGE_ORDER = (
     "bench", "protocols", "kernel", "bench_rep2", "bench_rep3",
-    "campaign", "staticcheck", "telemetry",
+    "campaign", "staticcheck", "telemetry", "flightrec",
     "scale1m", "scale1m_ba", "sweep250", "profile", "scale1m_full",
 )
 
@@ -229,6 +239,18 @@ def stage_specs(args) -> dict:
                 "argv": [
                     py, os.path.join(SCRIPTS, "run_report.py"),
                     "--capture-smoke",
+                ],
+                "env": cpu,
+                "budget": args.stage_budget or 900,
+            },
+            "flightrec": {
+                # Digest parity across engine pairs + the cost ledger for
+                # one kernel, at smoke shapes — proves the stage record
+                # shape battery_report.py renders.
+                "argv": [
+                    py, os.path.join(SCRIPTS, "divergence.py"), "--json",
+                    "--n", "64", "--shares", "3", "--horizon", "16",
+                    "--with-cost", "engine.sync._run_chunk_while",
                 ],
                 "env": cpu,
                 "budget": args.stage_budget or 900,
@@ -359,6 +381,20 @@ def stage_specs(args) -> dict:
             "env": sweep_env,
             "budget": args.stage_budget or 1200,
         },
+        "flightrec": {
+            # The flight recorder's hardware leg: all engine pairs with
+            # digests ON, bisected (a clean chip must report zero
+            # divergence — cross-engine bitwise parity ON HARDWARE),
+            # plus the engine.sync compiled-cost ledger from the chip's
+            # compiler. Tiny sims, standard XLA, compiles dominated by
+            # the staticcheck stage's — safe band.
+            "argv": [
+                py, os.path.join(SCRIPTS, "divergence.py"), "--json",
+                "--with-cost",
+            ],
+            "env": sweep_env,
+            "budget": args.stage_budget or 1800,
+        },
         "profile": {
             # One profiled bench pass + trace parse. --art-dir follows
             # the battery's artifact dir (default docs/artifacts) so a
@@ -449,9 +485,13 @@ def latest_records(art_dir: str) -> dict[str, dict]:
     return latest
 
 
-def run_stage(name: str, spec: dict) -> dict:
+def run_stage(name: str, spec: dict, hb_path: str | None = None) -> dict:
     """Run one stage to completion (or budget/crash) and return its
-    record. stdout lines that parse as JSON are the stage's results."""
+    record. stdout lines that parse as JSON are the stage's results.
+    ``hb_path`` is the stage's heartbeat file (P2P_HEARTBEAT in its
+    env): on a budget kill, the last beat rides the timeout record so
+    the artifact says WHERE the stage was when it died — chunk index,
+    ticks done, coverage — not just that it died."""
     t0 = time.monotonic()
     log(f"stage {name}: {' '.join(spec['argv'])} (budget {spec['budget']}s)")
     try:
@@ -488,6 +528,15 @@ def run_stage(name: str, spec: dict) -> dict:
         "stderr_tail": err[-1500:],
         "utc": datetime.now(timezone.utc).isoformat(timespec="seconds"),
     }
+    if rc == "timeout" and hb_path:
+        from p2p_gossip_tpu.telemetry import progress
+
+        hb = progress.read_heartbeat(hb_path)
+        age = progress.heartbeat_age_s(hb_path)
+        if hb is not None:
+            rec["heartbeat"] = hb
+        if age is not None:
+            rec["heartbeat_age_s"] = round(age, 1)
     log(f"stage {name}: rc={rc} wall={wall:.0f}s results={len(results)}")
     return rec
 
@@ -541,6 +590,13 @@ def main() -> int:
     probing = not (args.no_probe or args.smoke)
 
     os.makedirs(args.art_dir, exist_ok=True)
+    # Every stage streams liveness to one heartbeat file in the artifact
+    # dir: the chunk drivers rewrite it per chunk (telemetry/progress.py)
+    # and tunnel_watch.py reads its age to tell a long stage from a
+    # wedge. The battery itself reads it back on budget kills.
+    hb_path = os.path.join(args.art_dir, "heartbeat.json")
+    for spec in specs.values():
+        spec["env"] = {**spec["env"], "P2P_HEARTBEAT": hb_path}
     stamp = datetime.now(timezone.utc).strftime("%Y%m%dT%H%M%SZ")
     art_path = os.path.join(args.art_dir, f"battery_{stamp}.jsonl")
     latest = os.path.join(args.art_dir, "battery_latest.jsonl")
@@ -594,7 +650,7 @@ def main() -> int:
         return 1
 
     for i, name in enumerate(stages):
-        rec = run_stage(name, specs[name])
+        rec = run_stage(name, specs[name], hb_path=hb_path)
         if args.smoke:
             # Mark so done_stages never counts CPU smoke runs as on-chip
             # evidence (and artifact readers can tell them apart).

@@ -10,8 +10,14 @@
 
 Sections: run metadata, the span waterfall (host phases, nested by
 depth), total span time by phase, one block per harvested metric ring
-(per-tick frontier curve, messages/tick, loss drops), and the jit-cache
-counter samples (the PR-3 recompile-sentinel counters). The schema is
+(per-tick frontier curve, messages/tick, loss drops), the flight
+recorder's digest streams and progress beats, the compiled-cost ledger
+(``cost.*`` counters from scripts/cost_report.py), and the jit-cache
+counter samples (the PR-3 recompile-sentinel counters). Every section
+is optional — a spans-only stream (bench keeps device rings off)
+renders just the waterfall; a ring whose metric hits the uint32
+saturation sentinel (4294967295) gets a wrap warning instead of a
+silently-absurd total. The schema is
 `p2p_gossip_tpu/telemetry/schema.py`; ``--chrome`` output opens in
 chrome://tracing or https://ui.perfetto.dev (docs/OBSERVABILITY.md).
 
@@ -34,6 +40,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from p2p_gossip_tpu.telemetry import chrometrace, schema  # noqa: E402
 
 SPARK = "▁▂▃▄▅▆▇█"
+U32_MAX = 0xFFFFFFFF  # rings.u32sum saturation sentinel
 
 
 def log(msg: str) -> None:
@@ -54,25 +61,46 @@ def summarize(events) -> dict:
     spans = [e for e in events if e.get("type") == "span"]
     rings = [e for e in events if e.get("type") == "ring"]
     counters = [e for e in events if e.get("type") == "counter"]
+    digests = [e for e in events if e.get("type") == "digest"]
+    progress = [e for e in events if e.get("type") == "progress"]
     meta = next((e for e in events if e.get("type") == "meta"), None)
     span_s: dict = {}
     for s in spans:
         span_s[s["name"]] = round(span_s.get(s["name"], 0.0) + s["dur"], 4)
     ring_totals: dict = {}
+    wrap_warnings: list[str] = []
     for r in rings:
         agg = ring_totals.setdefault(
             r["kernel"], {c: 0 for c in schema.METRIC_COLUMNS} | {"rings": 0}
         )
         agg["rings"] += 1
         for col in schema.METRIC_COLUMNS:
-            agg[col] += sum(r["metrics"][col])
+            series = r.get("metrics", {}).get(col, [])
+            agg[col] += sum(series)
+            # rings.u32sum saturates at the uint32 max instead of
+            # wrapping — a tick stuck at the sentinel means the real
+            # figure is LARGER and every total containing it is a floor.
+            if any(v == U32_MAX for v in series):
+                wrap_warnings.append(
+                    f"{r['kernel']}.{col}: tick value saturated at "
+                    f"2^32-1 (t0={r.get('t0', 0)}) — totals are lower "
+                    "bounds"
+                )
+    digest_streams = sorted({
+        (d.get("kernel"), d.get("chunk"), d.get("replica"), d.get("shard"))
+        for d in digests
+    }, key=str)
     return {
         "events": len(events),
         "spans": len(spans),
         "rings": len(rings),
+        "digests": len(digests),
+        "digest_streams": len(digest_streams),
+        "progress": len(progress),
         "counters": {c["name"]: c["value"] for c in counters},
         "span_s_by_phase": span_s,
         "ring_totals": ring_totals,
+        "wrap_warnings": wrap_warnings,
         "run": (meta or {}).get("run", {}),
     }
 
@@ -116,23 +144,77 @@ def render(events, out=sys.stdout) -> None:
             )
             w(f"{r['kernel']}" + (f" [{prov}]" if prov else "")
               + f": {r['ticks']} tick(s) from t={r['t0']}\n")
-            m = r["metrics"]
-            frontier = m["frontier_bits"]
+            m = r.get("metrics", {})
+            frontier = m.get("frontier_bits", [])
             if frontier:
                 peak_t = max(range(len(frontier)), key=frontier.__getitem__)
                 w(f"  frontier/tick: {sparkline(frontier)} "
                   f"(peak {frontier[peak_t]} @ t={r['t0'] + peak_t})\n")
             for col in schema.METRIC_COLUMNS:
-                series = m[col]
+                series = m.get(col, [])
                 total = sum(series)
                 mean = total / max(len(series), 1)
+                sat = "  [SATURATED]" if any(
+                    v == U32_MAX for v in series
+                ) else ""
                 w(f"  {col:15s} total {total:>12}  mean/tick {mean:>10.1f}"
-                  f"  max {max(series) if series else 0:>10}\n")
+                  f"  max {max(series) if series else 0:>10}{sat}\n")
+    if summary["wrap_warnings"]:
+        w("\n--- WARNING: uint32 metric saturation ---\n")
+        for msg in summary["wrap_warnings"]:
+            w(f"  {msg}\n")
+    digests = [e for e in events if e.get("type") == "digest"]
+    if digests:
+        w("\n--- flight recorder: per-tick state digests ---\n")
+        for d in digests:
+            prov = ", ".join(
+                f"{k}={d[k]}" for k in ("chunk", "replica", "seed", "shard")
+                if k in d
+            )
+            values = d.get("values", [])
+            head = f"{values[0]:08x}" if values else "-"
+            tail = f"{values[-1]:08x}" if values else "-"
+            w(f"{d['kernel']}" + (f" [{prov}]" if prov else "")
+              + f": {d.get('ticks', len(values))} tick(s) from "
+              f"t={d.get('t0', 0)}  digest {head}..{tail}\n")
+        w("  (compare streams across engines: scripts/divergence.py)\n")
+    progress = [e for e in events if e.get("type") == "progress"]
+    if progress:
+        w("\n--- progress beats (per-chunk liveness) ---\n")
+        for p in progress:
+            parts = [f"{p.get('elapsed_s', 0.0):8.3f}s",
+                     p.get("kernel", "?")]
+            if "chunk" in p:
+                total = p.get("chunks_total")
+                parts.append(f"chunk {p['chunk']}"
+                             + (f"/{total}" if total is not None else ""))
+            if "ticks_done" in p:
+                parts.append(f"{p['ticks_done']} ticks")
+            if "coverage_pct" in p:
+                parts.append(f"{p['coverage_pct']:.1f}% coverage")
+            if "digest_head" in p:
+                parts.append(f"digest {p['digest_head']}")
+            w("  " + "  ".join(str(x) for x in parts) + "\n")
     counters = [e for e in events if e.get("type") == "counter"]
-    if counters:
+    cost = [c for c in counters if c["name"].startswith("cost.")]
+    other = [c for c in counters if not c["name"].startswith("cost.")]
+    if cost:
+        w("\n--- compiled-cost ledger (scripts/cost_report.py) ---\n")
+        by_entry: dict = {}
+        for c in cost:
+            entry, _, field = c["name"][len("cost."):].rpartition(".")
+            by_entry.setdefault(entry, {})[field] = c["value"]
+        for entry, fields in sorted(by_entry.items()):
+            w(f"  {entry}\n")
+            for field, val in sorted(fields.items()):
+                w(f"    {field:16s} {val}\n")
+    if other:
         w("\n--- counters (jit-cache sentinel samples) ---\n")
-        for c in counters:
+        for c in other:
             w(f"  {c['name']:48s} {c['value']}\n")
+    if not (spans or rings or digests or progress or counters):
+        w("\n(no span/ring/digest/progress/counter events — empty or "
+          "metadata-only stream)\n")
 
 
 def _capture_smoke(args) -> int:

@@ -94,7 +94,8 @@ def main() -> int:
     ap.add_argument("--json", action="store_true",
                     help="one JSON line on stdout instead of the human report")
     ap.add_argument("--fixture",
-                    choices=("f64", "recompile", "prng", "telemetry"),
+                    choices=("f64", "recompile", "prng", "telemetry",
+                             "digest"),
                     help="run one seeded regression fixture; exits non-zero "
                     "iff the analyzer (correctly) flags it")
     ap.add_argument("--lint-only", action="store_true",

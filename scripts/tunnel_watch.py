@@ -103,37 +103,81 @@ def probe_once(timeout_s: float) -> tuple[bool, str]:
 
 
 def fire_battery(log_path: str, battery_budget_s: float,
-                 extra_args: list[str]) -> tuple[int, dict]:
+                 extra_args: list[str], hb_path: str | None = None,
+                 stall_after_s: float = 900.0) -> tuple[int, dict]:
     """Run the full battery as a subprocess; its own artifacts land in
     docs/artifacts/battery_*.jsonl. Returns (exit code, parsed summary
     JSON or {}) — rc is -1 on watcher-side timeout (the battery budgets
     its own stages, so this outer budget only catches a hung battery
-    process). The summary feeds the latch decision: a --stages subset
-    or a --smoke run must not latch completion."""
+    process).
+
+    While the battery runs, the watcher polls the stage heartbeat file
+    (telemetry/progress.py — the battery exports P2P_HEARTBEAT to every
+    stage): a beat written by THIS run that then goes silent for
+    ``stall_after_s`` logs a ``battery_stall`` record with the last
+    payload (chunk, ticks, coverage), and a later fresh beat logs
+    ``battery_stall_recovered``. Observation only — the battery's own
+    per-stage budgets do the killing; the stall records exist so the
+    audit log says where a long stage sat, live, instead of after the
+    fact. The summary feeds the latch decision: a --stages subset or a
+    --smoke run must not latch completion."""
+    import tempfile
+
+    from p2p_gossip_tpu.telemetry import progress
+
     argv = [sys.executable, os.path.join(SCRIPTS, "onchip_battery.py"),
             *extra_args]
     log_line(log_path, {"event": "battery_start", "argv": argv})
     t0 = time.monotonic()
+    wall_t0 = time.time()
 
-    def text_of(x) -> str:
-        if x is None:
-            return ""
-        return x.decode(errors="replace") if isinstance(x, bytes) else x
-
-    try:
-        proc = subprocess.run(
-            argv, timeout=battery_budget_s, capture_output=True, text=True,
+    with tempfile.TemporaryFile(mode="w+") as out_f, \
+            tempfile.TemporaryFile(mode="w+") as err_f:
+        proc = subprocess.Popen(
+            argv, stdout=out_f, stderr=err_f, text=True,
             env=filtered_env(), cwd=REPO,
         )
-        rc = proc.returncode
-        tail = (proc.stdout.strip().splitlines() or [""])[-1]
-        err_tail = proc.stderr
-    except subprocess.TimeoutExpired as e:
-        rc, tail = -1, "watcher-side battery budget expired"
+        deadline = time.monotonic() + battery_budget_s
+        stalled = False
+        timed_out = False
+        while proc.poll() is None:
+            if time.monotonic() >= deadline:
+                timed_out = True
+                proc.kill()
+                proc.wait()
+                break
+            time.sleep(min(30.0, max(1.0, deadline - time.monotonic())))
+            if not hb_path:
+                continue
+            age = progress.heartbeat_age_s(hb_path)
+            # Only a beat from THIS battery counts: a leftover file from
+            # an earlier run is always "stale" and would fire instantly.
+            this_run = age is not None and (time.time() - age) >= wall_t0
+            now_stalled = this_run and age > stall_after_s
+            if now_stalled and not stalled:
+                log_line(log_path, {
+                    "event": "battery_stall",
+                    "hb_age_s": round(age, 1),
+                    "last_beat": progress.read_heartbeat(hb_path) or {},
+                })
+            elif stalled and this_run and not now_stalled:
+                log_line(log_path, {
+                    "event": "battery_stall_recovered",
+                    "hb_age_s": round(age, 1),
+                })
+            stalled = now_stalled
+        rc = -1 if timed_out else proc.returncode
+        out_f.seek(0)
+        err_f.seek(0)
+        stdout_text = out_f.read()
+        err_tail = err_f.read()
+    if timed_out:
         # Salvage whatever the battery printed before the kill — a failed
         # battery with no recorded reason defeats this script's purpose.
-        err_tail = text_of(e.stderr)
-        tail += " | partial stdout: " + text_of(e.stdout)[-500:]
+        tail = ("watcher-side battery budget expired | partial stdout: "
+                + stdout_text[-500:])
+    else:
+        tail = (stdout_text.strip().splitlines() or [""])[-1]
     log_line(log_path, {
         "event": "battery_done", "rc": rc,
         "wall_s": round(time.monotonic() - t0, 1), "summary": tail[-2000:],
@@ -200,6 +244,17 @@ def main() -> int:
     ap.add_argument("--battery-args", default="",
                     help="extra args passed through to onchip_battery.py, "
                     "space-separated (e.g. '--stages bench,kernel')")
+    ap.add_argument("--heartbeat",
+                    default=os.path.join(
+                        os.environ.get(
+                            "P2P_BATTERY_DIR",
+                            os.path.join(REPO, "docs", "artifacts")),
+                        "heartbeat.json"),
+                    help="stage heartbeat file to watch for stalls "
+                    "(matches onchip_battery.py's P2P_HEARTBEAT)")
+    ap.add_argument("--stall-after", type=float, default=900.0,
+                    help="log a battery_stall record when this battery's "
+                    "heartbeat goes silent this many seconds")
     args = ap.parse_args()
 
     if os.path.exists(done_path(args.log)):
@@ -239,11 +294,20 @@ def watch_loop(args) -> int:
     })
     while True:
         ok, err = probe_once(args.probe_timeout)
+        # The heartbeat age rides every probe line: one grep of the audit
+        # log then shows tunnel health AND stage liveness side by side.
+        from p2p_gossip_tpu.telemetry import progress
+
+        hb_age = progress.heartbeat_age_s(args.heartbeat)
         log_line(args.log, {"event": "probe", "ok": ok,
-                            "err": err if not ok else ""})
+                            "err": err if not ok else "",
+                            "hb_age_s": (round(hb_age, 1)
+                                         if hb_age is not None else None)})
         if ok:
             fires += 1
-            rc, summary = fire_battery(args.log, args.battery_budget, extra)
+            rc, summary = fire_battery(args.log, args.battery_budget, extra,
+                                       hb_path=args.heartbeat,
+                                       stall_after_s=args.stall_after)
             if rc == 0:
                 from onchip_battery import STAGE_ORDER
 

@@ -1,0 +1,280 @@
+"""Flight-recorder tests (ISSUE 9): per-tick state digests (jnp/np twin
+parity, pad-width invariance), digest-stream alignment and fault-
+injection bisection across solo AND vmapped engines, the event-vs-sync
+parity bridge, heartbeat atomicity/staleness, and the uint32 metric
+saturation guard."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import p2p_gossip_tpu as pg
+from p2p_gossip_tpu import telemetry
+from p2p_gossip_tpu.engine.sync import run_sync_sim
+from p2p_gossip_tpu.telemetry import (
+    compare,
+    digest as tel_digest,
+    progress,
+    rings as tel_rings,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    progress.configure_heartbeat(None)
+    yield
+    telemetry.reset()
+    progress.configure_heartbeat(None)
+
+
+@pytest.fixture
+def graph():
+    return pg.erdos_renyi(48, 0.15, seed=0)
+
+
+@pytest.fixture
+def sched(graph):
+    rng = np.random.default_rng(0)
+    return pg.Schedule(
+        graph.n,
+        rng.integers(0, graph.n, 3).astype(np.int32),
+        np.array([0, 0, 2], dtype=np.int32),
+    )
+
+
+def digest_stream(kernel, **coords):
+    return compare.select_stream(
+        compare.digest_streams(telemetry.events(), kernel=kernel), **coords
+    )
+
+
+# ---------------------------------------------------------------------------
+# Digest function: jnp/np twin parity + the sparse-fold invariances
+# ---------------------------------------------------------------------------
+
+def test_digest_jnp_matches_np_twin():
+    rng = np.random.default_rng(7)
+    seen = rng.integers(0, 2**32, (12, 3), dtype=np.uint32)
+    received = rng.integers(0, 50, 12).astype(np.int32)
+    sent = rng.integers(0, 90, 12).astype(np.int32)
+    import jax.numpy as jnp
+
+    dev = int(tel_digest.tick_digest(
+        jnp.asarray(seen), jnp.asarray(received), jnp.asarray(sent)
+    ))
+    host = tel_digest.tick_digest_np(seen, received, sent)
+    assert dev == host
+
+
+def test_digest_pad_width_invariance():
+    """Zero pad words/rows must not change the digest — the property
+    that lets engines with different chunk pads share one stream."""
+    rng = np.random.default_rng(3)
+    seen = rng.integers(0, 2**32, (8, 1), dtype=np.uint32)
+    received = rng.integers(0, 9, 8).astype(np.int32)
+    sent = rng.integers(0, 9, 8).astype(np.int32)
+    base = tel_digest.tick_digest_np(seen, received, sent)
+    # Pad the word axis (campaign word-rounds vs solo 128-word chunks).
+    wide = np.concatenate(
+        [seen, np.zeros((8, 4), dtype=np.uint32)], axis=1
+    )
+    assert tel_digest.tick_digest_np(wide, received, sent) == base
+    # Pad the node axis with all-zero rows (sharded runners' n_padded),
+    # salting real rows by their global ids.
+    tall_seen = np.concatenate(
+        [seen, np.zeros((4, 1), dtype=np.uint32)], axis=0
+    )
+    tall_r = np.concatenate([received, np.zeros(4, dtype=np.int32)])
+    tall_s = np.concatenate([sent, np.zeros(4, dtype=np.int32)])
+    assert tel_digest.tick_digest_np(tall_seen, tall_r, tall_s) == base
+    # sent_hi all-zero folds like an absent high word (flood lo-only
+    # convention vs the protocols' lo+hi split).
+    assert tel_digest.tick_digest_np(
+        seen, received, sent, sent_hi=np.zeros(8, dtype=np.int32)
+    ) == base
+
+
+def test_digest_all_zero_state_is_zero():
+    z = np.zeros((6, 2), dtype=np.uint32)
+    zi = np.zeros(6, dtype=np.int32)
+    assert tel_digest.tick_digest_np(z, zi, zi) == 0
+
+
+# ---------------------------------------------------------------------------
+# Stream alignment + fault injection (pure compare-layer semantics)
+# ---------------------------------------------------------------------------
+
+def test_first_divergence_and_inject_fault():
+    a = {t: 1000 + t for t in range(10)}
+    clean = compare.first_divergence(a, dict(a))
+    assert not clean.diverged and clean.compared == 10
+    faulty = compare.inject_fault(a, 6, bit=3)
+    div = compare.first_divergence(a, faulty)
+    assert div.diverged and div.tick == 6
+    assert div.matched_head == 6
+    assert div.a_value ^ div.b_value == 1 << 3
+    with pytest.raises(ValueError):
+        compare.inject_fault(a, 99)
+
+
+def test_alignment_compares_only_common_ticks():
+    # A while-exit stream (stops at quiescence) vs a fori stream
+    # (writes to the horizon): the tail is not divergence.
+    a = {t: t * 7 for t in range(5)}
+    b = {t: t * 7 for t in range(9)}
+    div = compare.first_divergence(a, b)
+    assert not div.diverged
+    assert div.compared == 5 and div.only_b == 4
+
+
+def test_select_stream_errors():
+    streams = {
+        ("k1", 0, None, None): {0: 1},
+        ("k1", 1, None, None): {0: 2},
+    }
+    with pytest.raises(KeyError):
+        compare.select_stream(streams, kernel="nope")
+    with pytest.raises(ValueError):
+        compare.select_stream(streams, kernel="k1")
+    assert compare.select_stream(streams, kernel="k1", chunk=1) == {0: 2}
+
+
+# ---------------------------------------------------------------------------
+# Cross-engine bisection: solo sync, the vmapped campaign, and the
+# host event engine all join the same comparison
+# ---------------------------------------------------------------------------
+
+def test_bisector_solo_vs_campaign_replica(graph):
+    """Replica 0 of the vmapped flood campaign is digest-identical to
+    its solo twin, and an injected fault is located exactly — on both
+    the solo and the vmapped side."""
+    from p2p_gossip_tpu.batch.campaign import (
+        flood_replicas,
+        run_coverage_campaign,
+    )
+
+    reps = flood_replicas(graph, 3, [5, 6], 16)
+    telemetry.configure(None, rings=True)
+    run_sync_sim(graph, reps.replica_schedule(0, 16), 16)
+    solo = digest_stream("engine.sync")
+    telemetry.reset()
+    telemetry.configure(None, rings=True)
+    run_coverage_campaign(graph, reps, 16)
+    camp = digest_stream("batch.campaign", replica=0)
+    assert compare.first_divergence(solo, camp).diverged is False
+    t = sorted(set(solo) & set(camp))[2]
+    for side_a, side_b in ((solo, camp), (camp, solo)):
+        div = compare.first_divergence(
+            side_a, compare.inject_fault(side_b, t)
+        )
+        assert div.diverged and div.tick == t
+
+
+def test_bisector_event_vs_sync(graph, sched):
+    """The host event engine's on_tick digests equal the compiled sync
+    kernel's stream over the executed prefix."""
+    cap = compare.capture_event_digests(graph, sched, 20)
+    telemetry.configure(None, rings=True)
+    run_sync_sim(graph, sched, 20)
+    sync = digest_stream("engine.sync")
+    div = compare.first_divergence(cap.digests, sync)
+    assert not div.diverged and div.compared > 3
+    faulty = compare.inject_fault(sync, min(sync))
+    assert compare.first_divergence(
+        cap.digests, faulty
+    ).tick == min(sync)
+
+
+def test_capture_window_snapshots(graph, sched):
+    cap = compare.capture_event_digests(graph, sched, 12, window=(2, 4))
+    assert sorted(cap.received) == [2, 3, 4]
+    assert all(cap.received[t].shape == (graph.n,) for t in cap.received)
+    # Frontier totals are monotone in a lossless flood.
+    assert cap.received[4].sum() >= cap.received[2].sum()
+
+
+def test_divergence_script_fault_selftest():
+    """scripts/divergence.py --inject-fault T must exit 0 and name T."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "divergence.py"),
+         "--pair", "native-sync", "--n", "48", "--shares", "3",
+         "--horizon", "12", "--inject-fault", "4", "--json"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ok"] and out["pairs"][0]["located_tick"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Progress beats + heartbeat file
+# ---------------------------------------------------------------------------
+
+def test_progress_events_carry_digest_head(graph, sched):
+    telemetry.configure(None, rings=True)
+    run_sync_sim(graph, sched, 16)
+    beats = [e for e in telemetry.events() if e["type"] == "progress"]
+    assert beats, "no progress events emitted"
+    assert all("elapsed_s" in b and "kernel" in b for b in beats)
+    heads = [b["digest_head"] for b in beats if "digest_head" in b]
+    assert heads and all(len(h) == 8 for h in heads)
+
+
+def test_heartbeat_atomic_write_and_read(tmp_path):
+    hb = str(tmp_path / "hb.json")
+    progress.configure_heartbeat(hb)
+    progress.write_heartbeat({"kernel": "k", "chunk": 1})
+    data = progress.read_heartbeat(hb)
+    assert data["kernel"] == "k" and data["chunk"] == 1
+    assert "utc" in data and data["pid"] == os.getpid()
+    # Atomic replace leaves no tmp sibling behind.
+    assert os.listdir(tmp_path) == ["hb.json"]
+    # A torn/garbage file reads as None, never raises.
+    with open(hb, "w") as f:
+        f.write('{"half": ')
+    assert progress.read_heartbeat(hb) is None
+
+
+def test_heartbeat_staleness(tmp_path):
+    hb = str(tmp_path / "hb.json")
+    assert progress.is_stale(hb, 10.0)  # missing = stale
+    progress.write_heartbeat({"kernel": "k"}, hb)
+    assert not progress.is_stale(hb, 10.0)
+    old = os.stat(hb).st_mtime - 120.0
+    os.utime(hb, (old, old))
+    assert progress.heartbeat_age_s(hb) > 100.0
+    assert progress.is_stale(hb, 60.0)
+
+
+def test_heartbeat_works_with_telemetry_off(tmp_path, graph, sched):
+    """Liveness must not require paying for instrumented kernels: with
+    the sink off and only P2P_HEARTBEAT set, chunk drivers still beat."""
+    hb = str(tmp_path / "hb.json")
+    progress.configure_heartbeat(hb)
+    run_sync_sim(graph, sched, 8)
+    data = progress.read_heartbeat(hb)
+    assert data is not None and "kernel" in data
+    assert "digest_head" not in data  # digests off along with the sink
+
+
+# ---------------------------------------------------------------------------
+# uint32 saturation guard
+# ---------------------------------------------------------------------------
+
+def test_u32sum_saturates_instead_of_wrapping():
+    import jax.numpy as jnp
+
+    exact = int(tel_rings.u32sum(jnp.asarray([3, 5, 7], dtype=jnp.uint32)))
+    assert exact == 15
+    big = jnp.full((3,), tel_rings.U32_MAX, dtype=jnp.uint32)
+    assert int(tel_rings.u32sum(big)) == tel_rings.U32_MAX
+    near = jnp.asarray([tel_rings.U32_MAX - 1, 1], dtype=jnp.uint32)
+    assert int(tel_rings.u32sum(near)) == tel_rings.U32_MAX - 0

@@ -326,10 +326,14 @@ def test_chrome_trace_round_trip(graph, sched):
         assert a["depth"] == b["depth"]
         assert abs(a["dur"] - b["dur"]) < 1e-6
     # Ring columns become device-tick counter series on pid 2.
-    counters = [
+    # pid 2 carries both ring-column and digest counter tracks; the
+    # digest rows are named "digest:<label>".
+    pid2 = [
         r for r in trace["traceEvents"]
         if r.get("ph") == "C" and r.get("pid") == 2
     ]
+    counters = [r for r in pid2 if not r["name"].startswith("digest:")]
+    digest_rows = [r for r in pid2 if r["name"].startswith("digest:")]
     assert counters
     n_ring_samples = sum(
         len(series)
@@ -337,6 +341,10 @@ def test_chrome_trace_round_trip(graph, sched):
         for series in e["metrics"].values()
     )
     assert len(counters) == n_ring_samples
+    n_digest_samples = sum(
+        len(e["values"]) for e in events if e["type"] == "digest"
+    )
+    assert len(digest_rows) == n_digest_samples
 
 
 def test_emit_ring_trims_trailing_zeros():
