@@ -410,6 +410,34 @@ def main() -> None:
         except Exception as e:
             log(f"cost report: did not complete ({type(e).__name__})")
 
+    # Frontier-exchange crossover (scripts/cost_report.py
+    # --exchange-only): dense vs sparse-delta exchange words/tick and
+    # steady-state delta-buffer occupancy on the two benchmark topology
+    # families, from the sharded flood runner's on-device counters.
+    # Host-CPU subprocess with the same wedged-tunnel isolation and
+    # honest platform label as the cost ledger above (the ``platform``
+    # field inside says "cpu" — chip-scale numbers are the battery's
+    # exchange stage / mesh_rehearsal --exchange legs). None on smoke
+    # or when the measurement could not run.
+    exchange = None
+    if not smoke:
+        ex_args = [sys.executable, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts",
+            "cost_report.py"), "--exchange-only", "--families",
+            "erdos_renyi,barabasi_albert"]
+        try:
+            exr = subprocess.run(
+                ex_args, capture_output=True, text=True, timeout=600,
+                env=sc_env,
+            )
+            if exr.returncode == 0:
+                exchange = json.loads(exr.stdout.strip().splitlines()[-1])
+            else:
+                log(f"exchange report: FAIL (rc={exr.returncode}) "
+                    f"{exr.stdout[-400:]}")
+        except Exception as e:
+            log(f"exchange report: did not complete ({type(e).__name__})")
+
     row = {
         "metric": (
             f"node-updates/sec ({n // 1000}K-node p={p:g} gossip "
@@ -450,6 +478,10 @@ def main() -> None:
         # entry, platform-labeled); None on smoke or when it could not
         # run.
         "cost": cost,
+        # Dense-vs-delta exchange words/tick + delta occupancy per
+        # benchmark topology family (platform-labeled, see above); None
+        # on smoke or when it could not run.
+        "exchange": exchange,
     }
     row["campaign"] = {
         "metric": (

@@ -25,6 +25,14 @@ The delay-line history ring has two layouts (``ring_mode``):
 otherwise switches to sharded when the replicated ring would exceed
 ``RING_REPLICATED_MAX_BYTES`` per chip.
 
+On top of the sharded ring, ``exchange="delta"`` swaps the per-delay
+slice all_gathers for the sparse frontier-delta exchange
+(`parallel/exchange.py`): one fixed-capacity all_to_all of changed-word
+(idx, val) pairs per tick — traffic scales with the frontier delta
+instead of N — with a mesh-uniform dense fallback per overflowed ring
+slot. Bitwise-identical counters on every path; modeled and achieved
+wire words are reported in ``stats.extra['exchange']``.
+
 Single-device equivalence is bitwise for BOTH layouts: the tick body ORs
 the same edge set in either decomposition, and the tests assert identical
 per-node counters against `engine.sync` and `engine.event` across mesh
@@ -199,28 +207,120 @@ def _resolve_and_stage_ring(
     ell_mask: np.ndarray,
     block: int = DEFAULT_DEGREE_BLOCK,
     bucket_min_rows: int = 2048,
+    exchange: str = "dense",
 ):
     """Resolve the ring layout and stage its operands in one step — the
     shared stanza of both sharded entry points. Returns (ring_mode,
-    ell_args, delay_values, bucket_counts, ring_extra) where
-    ``ring_extra`` is the ``stats.extra['ring']`` report dict and
+    ell_args, delay_values, bucket_counts, ring_extra, exchange_plan)
+    where ``ring_extra`` is the ``stats.extra['ring']`` report dict,
     ``bucket_counts`` is the static per-group bucket layout the runner
-    unflattens ``ell_args`` by."""
+    unflattens ``ell_args`` by, and ``exchange_plan`` is the resolved
+    frontier-exchange path: ``(mode, need, capacity, extra)`` — mode
+    "dense" (slice all_gathers) or "delta" (sparse frontier-delta
+    buffers over the cached cut structure, parallel/exchange.py), with
+    ``need`` the (n_padded, n_shards) cut membership to stage and
+    ``extra`` the ``stats.extra['exchange']`` report dict."""
+    if exchange not in ("dense", "delta", "auto"):
+        raise ValueError(f"unknown exchange mode {exchange!r}")
+    if exchange == "delta":
+        # The delta path compresses the sharded ring's write slices;
+        # a replicated ring has no read-time exchange to compress.
+        ring_mode = "sharded"
     ring_mode, ring_bytes = resolve_ring_mode(
         ring_mode, uniform, ring, n_padded, n_node_shards, w
     )
+    if exchange == "auto":
+        exchange = (
+            "delta"
+            if ring_mode == "sharded" and n_node_shards > 1
+            else "dense"
+        )
     ell_args, delay_values, bucket_counts = _stage_ell_args(
         uniform, ell_idx, ell_delay, ell_mask, n_node_shards, block,
         bucket_min_rows,
     )
+    delay_splits = len(delay_values) if delay_values else 1
     ring_extra = {
         "mode": ring_mode,
         "bytes_per_chip": ring_bytes,
         "slots": ring,
-        "delay_splits": len(delay_values) if delay_values else 1,
+        "delay_splits": delay_splits,
         "degree_buckets": bucket_counts,
     }
-    return ring_mode, ell_args, delay_values, bucket_counts, ring_extra
+    n_loc = n_padded // n_node_shards
+    if exchange == "delta":
+        from p2p_gossip_tpu.parallel import exchange as exch
+
+        need, need_counts = exch.plan_flood_exchange(
+            ell_idx, ell_mask, n_node_shards
+        )
+        capacity = exch.delta_capacity(
+            int(need_counts.max()) if need_counts.size else 1,
+            n_loc, w, delay_splits,
+        )
+        exchange_extra = {
+            "mode": "delta",
+            "capacity": capacity,
+            "max_cut_rows": int(need_counts.max()) if need_counts.size else 0,
+            "modeled_dense_words_per_tick": exch.modeled_exchange_words_per_tick(
+                "dense" if ring_mode == "sharded" else "replicated",
+                n_shards=n_node_shards, n_loc=n_loc, w=w,
+                delay_splits=delay_splits,
+            ),
+            "modeled_delta_words_per_tick": exch.modeled_exchange_words_per_tick(
+                "delta", n_shards=n_node_shards, n_loc=n_loc, w=w,
+                capacity=capacity,
+            ),
+        }
+        exchange_plan = ("delta", need, capacity, exchange_extra)
+    else:
+        from p2p_gossip_tpu.parallel import exchange as exch
+
+        mode = "dense" if ring_mode == "sharded" else "replicated"
+        exchange_plan = ("dense", None, 0, {
+            "mode": mode,
+            "capacity": 0,
+            "modeled_dense_words_per_tick": exch.modeled_exchange_words_per_tick(
+                mode, n_shards=n_node_shards, n_loc=n_loc, w=w,
+                delay_splits=delay_splits,
+            ),
+        })
+    return (
+        ring_mode, ell_args, delay_values, bucket_counts, ring_extra,
+        exchange_plan,
+    )
+
+
+def _achieved_exchange_report(
+    exchange_extra: dict,
+    counters,
+    ticks: int,
+    n_shards: int,
+    n_loc: int,
+    w: int,
+    capacity: int,
+) -> dict:
+    """Fold the delta runner's achieved-traffic counters into the
+    ``stats.extra['exchange']`` report: used entries / overflow writes /
+    dense fallbacks summed over passes and share shards, plus the
+    achieved per-chip per-tick wire words (fixed all_to_all footprint +
+    amortized dense fallbacks) and the steady-state buffer occupancy —
+    used entries over the wire-relevant slot count."""
+    k = n_shards
+    extra = dict(exchange_extra)
+    extra["achieved_used_entries"] = int(counters[0])
+    extra["overflow_write_ticks"] = int(counters[1])
+    extra["dense_fallback_reads"] = int(counters[2])
+    extra["exchange_ticks"] = int(ticks)
+    if ticks:
+        extra["achieved_delta_words_per_tick"] = (
+            (k - 1) * 2 * capacity
+            + int(counters[2]) * (k - 1) * n_loc * w / ticks
+        )
+        extra["delta_occupancy"] = int(counters[0]) / (
+            ticks * k * max(1, k - 1) * capacity
+        )
+    return extra
 
 
 def _stage_ell_args(
@@ -342,6 +442,8 @@ def build_sharded_runner(
     connect_tick: int = 0,
     bucket_counts: tuple = (1,),
     telemetry_on: bool = False,
+    exchange_mode: str = "dense",
+    delta_capacity: int = 0,
 ):
     """Compile the per-pass runner: each shares-shard processes its own
     ``chunk_size`` shares over the row-sharded graph, from the chunk's first
@@ -371,7 +473,21 @@ def build_sharded_runner(
     psum'ed over the nodes axis only, so each shares-shard's ring covers
     ITS share chunk (the host emits one ring event per shard, matching
     the solo engine's one-event-per-chunk convention) — returned stacked
-    per share-shard as one extra trailing output."""
+    per share-shard as one extra trailing output.
+
+    ``exchange_mode`` "delta" (sharded ring only) replaces the per-delay
+    slice all_gathers with the sparse frontier-delta exchange
+    (parallel/exchange.py): each tick ships at most ``delta_capacity``
+    changed-word entries per destination over one all_to_all, readers
+    reconstruct slices by scatter + own-slice overlay, and a mesh-uniform
+    per-slot overflow flag routes readers to the dense all_gather when a
+    shard's delta outgrew the buffer — bitwise-identical results either
+    way (OR-monotone merge). Takes one extra trailing operand (the
+    (n_loc, n_shards) cut membership from `plan_flood_exchange`) and
+    returns one extra trailing output: a per-share-shard (8,) uint32
+    counter row [used_entries_lo, used_entries_hi, overflow_write_ticks,
+    dense_fallback_reads, exchange_ticks, 0, 0, 0] for achieved-traffic
+    accounting (host side: `stats.extra['exchange']`)."""
     n_share_shards = mesh.shape[SHARES_AXIS]
     n_node_shards = mesh.shape[NODES_AXIS]
     n_loc = n_padded // n_node_shards
@@ -383,6 +499,19 @@ def build_sharded_runner(
     cov_w = bitmask.num_words(cov_slots)
     sharded_ring = ring_mode == "sharded"
     hist_rows = n_loc if sharded_ring else n_padded
+    delta = exchange_mode == "delta"
+    if delta and not sharded_ring:
+        raise ValueError("exchange_mode='delta' requires ring_mode='sharded'")
+    if delta and delta_capacity < 1:
+        raise ValueError(f"delta_capacity must be >= 1, got {delta_capacity}")
+    if delta:
+        from p2p_gossip_tpu.parallel import exchange as exch
+    # Static gather-group count (one per distinct delay value): the
+    # per-tick dense exchange multiplier in the telemetry traffic row.
+    n_groups = (
+        1 if uniform_delay is not None
+        else (len(delay_values) if delay_values else 1)
+    )
 
     def local_coverage(seen):
         return bitmask.coverage_per_slot(seen[:, :cov_w], cov_slots)
@@ -390,6 +519,7 @@ def build_sharded_runner(
     def pass_fn(
         ell_args, degree, churn_start, churn_end,
         origins, gen_ticks, t_start, last_gen, snap_ticks,
+        *delta_args,
     ):
         # Local shapes: ell_args arrays (n_loc, cols); churn_* (n_loc, K)
         # downtime intervals ((n_loc, 1) zeros when churn is off — the
@@ -425,6 +555,27 @@ def build_sharded_runner(
         dig_i = 8 + (1 if tel else 0)
         if dig:
             state = state + (tel_digest.init(horizon),)           # digests
+        ex_i = 8 + (1 if tel else 0) + (1 if dig else 0)
+        if delta:
+            need = delta_args[0]  # (n_loc, n_shards) cut membership
+            state = state + (
+                # Received-delta rings, slot-aligned with hist: axis 1 is
+                # the SOURCE shard post all_to_all. idx -1 = empty.
+                jnp.full(
+                    (ring_size, n_node_shards, delta_capacity),
+                    -1, dtype=jnp.int32,
+                ),
+                jnp.zeros(
+                    (ring_size, n_node_shards, delta_capacity),
+                    dtype=jnp.uint32,
+                ),
+                # Mesh-uniform per-slot overflow flags: readers take the
+                # dense all_gather branch for flagged slots.
+                jnp.zeros((ring_size,), dtype=jnp.bool_),
+                # [used_lo, used_hi, overflow_writes, fallback_reads,
+                #  exchange_ticks, 0, 0, 0]
+                jnp.zeros((8,), dtype=jnp.uint32),
+            )
 
         def cond(state):
             t, _, hist = state[0], state[1], state[2]
@@ -437,16 +588,39 @@ def build_sharded_runner(
             ) > 0
             return (t < horizon) & (in_flight | (t <= last_gen))
 
-        def read_slice(hist, t, delay):
+        def read_slice(hist, dstate, t, delay):
             """The global (t - delay) frontier: a local ring read when the
-            ring is replicated, an all_gather of the local slice when it is
-            sharded — the read-time frontier exchange, riding ICI."""
-            sl = hist[jnp.mod(t - delay, ring_size)]
-            if sharded_ring:
-                sl = lax.all_gather(sl, NODES_AXIS, axis=0, tiled=True)
-            return sl
+            ring is replicated, an all_gather of the local slice when it
+            is sharded (the read-time dense frontier exchange, riding
+            ICI) — or, on the delta path, a reconstruction from the
+            received frontier-delta buffers: scatter the slot's (idx,
+            val) entries onto a zero canvas and overlay this shard's own
+            slice. Slots whose write overflowed the delta capacity carry
+            a mesh-uniform flag and fall back to the dense all_gather —
+            both branches are static-shaped."""
+            slot = jnp.mod(t - delay, ring_size)
+            sl = hist[slot]
+            if not sharded_ring:
+                return sl
+            if not delta:
+                return lax.all_gather(sl, NODES_AXIS, axis=0, tiled=True)
+            didx_ring, dval_ring, dflag_ring = dstate
 
-        def arrivals_for(hist, t, loss_cfg=loss):
+            def dense_read(_):
+                return lax.all_gather(sl, NODES_AXIS, axis=0, tiled=True)
+
+            def delta_read(_):
+                recon = exch.scatter_deltas(
+                    didx_ring[slot], dval_ring[slot], n_loc, w, n_padded
+                )
+                # Own rows never ride the wire (plan_flood_exchange
+                # excludes them): overlay the local slice directly.
+                return lax.dynamic_update_slice(recon, sl, (row_offset, 0))
+
+            return lax.cond(dflag_ring[slot], dense_read, delta_read,
+                            operand=None)
+
+        def arrivals_for(hist, dstate, t, loss_cfg=loss):
             # One gather group per delay value (one group total under a
             # uniform delay); read_slice resolves local vs all_gathered
             # per ring layout. Within a group, the degree buckets
@@ -474,7 +648,7 @@ def build_sharded_runner(
             acc = jnp.zeros((n_loc, w), dtype=jnp.uint32)
             pos = 0
             for gi, dval in enumerate(group_delays):
-                sl = read_slice(hist, t, dval)
+                sl = read_slice(hist, dstate, t, dval)
                 if bucket_counts[gi] == 0:
                     # Direct full-width pair (uniform-degree group —
                     # bucketing would save <25%, see _stage_ell_args):
@@ -514,16 +688,32 @@ def build_sharded_runner(
 
         def body(state):
             t, seen, hist, received, sent, snaps, cov_run, cov_hist = state[:8]
+            if delta:
+                didx_ring, dval_ring, dflag_ring, ectr = state[ex_i:ex_i + 4]
+                dstate = (didx_ring, dval_ring, dflag_ring)
+                # Dense fallbacks this tick: one per delay group whose
+                # read slot carries the (mesh-uniform) overflow flag.
+                fb_t = jnp.zeros((), dtype=jnp.uint32)
+                for dv in (
+                    (uniform_delay,) if uniform_delay is not None
+                    else delay_values
+                ):
+                    fb_t = fb_t + dflag_ring[
+                        jnp.mod(t - dv, ring_size)
+                    ].astype(jnp.uint32)
+            else:
+                dstate = None
             if num_snaps:
                 snaps = jnp.where(
                     (snap_ticks == t)[:, None], received[None, :], snaps
                 )
-            arrivals = arrivals_for(hist, t)
+            arrivals = arrivals_for(hist, dstate, t)
             if tel:
                 received_in = received
                 arrivals_raw = arrivals  # post-loss, pre-churn wire view
                 arrivals_nl = (
-                    arrivals_for(hist, t, None) if loss is not None else None
+                    arrivals_for(hist, dstate, t, None)
+                    if loss is not None else None
                 )
             up = up_mask_jnp(churn_start, churn_end, t)
             arrivals = jnp.where(up[:, None], arrivals, jnp.uint32(0))
@@ -567,6 +757,45 @@ def build_sharded_runner(
                     newly_out, NODES_AXIS, axis=0, tiled=True
                 )
                 hist = hist.at[jnp.mod(t, ring_size)].set(newly_full)
+            if delta:
+                # Write-time sparse exchange: pack this tick's changed
+                # words per destination (cut-restricted, self-excluded)
+                # and ship ONE all_to_all of fixed-capacity buffers —
+                # post-exchange axis 0 is the source shard. A truncated
+                # buffer anywhere on the mesh raises the slot's uniform
+                # overflow flag so every reader takes the dense branch.
+                cidx, cval, ccounts = exch.compress_deltas(
+                    newly_out, need, delta_capacity
+                )
+                idx_recv = lax.all_to_all(
+                    cidx, NODES_AXIS, split_axis=0, concat_axis=0
+                )
+                val_recv = lax.all_to_all(
+                    cval, NODES_AXIS, split_axis=0, concat_axis=0
+                )
+                ovf = lax.psum(
+                    jnp.any(ccounts > delta_capacity).astype(jnp.int32),
+                    NODES_AXIS,
+                ) > 0
+                slot_w = jnp.mod(t, ring_size)
+                didx_ring = didx_ring.at[slot_w].set(idx_recv)
+                dval_ring = dval_ring.at[slot_w].set(val_recv)
+                dflag_ring = dflag_ring.at[slot_w].set(ovf)
+                # Achieved-traffic counters (uniform within the share
+                # shard): entries actually shipped mesh-wide this tick,
+                # overflow write ticks, dense fallback reads, ticks.
+                used_t = lax.psum(
+                    jnp.sum(jnp.minimum(ccounts, delta_capacity)),
+                    NODES_AXIS,
+                ).astype(jnp.uint32)
+                lo, hi = bitmask.add_u64(ectr[0], ectr[1], used_t)
+                ectr = jnp.stack((
+                    lo, hi,
+                    ectr[2] + ovf.astype(jnp.uint32),
+                    ectr[3] + fb_t,
+                    ectr[4] + jnp.uint32(1),
+                    ectr[5], ectr[6], ectr[7],
+                ))
             if record_coverage:
                 # Incremental, like engine.sync: newly_out bits are
                 # disjoint across ticks, so the mesh-wide coverage is a
@@ -579,12 +808,28 @@ def build_sharded_runner(
                 )
             out = (t + 1, seen, hist, received, sent, snaps, cov_run, cov_hist)
             if tel:
+                # Per-chip state-slice exchange words received this tick
+                # (ICI traffic model, see exchange.py): the NODES psum
+                # below turns it into the mesh total for this share
+                # chunk, like the other columns.
+                if delta:
+                    ex_words = (
+                        jnp.uint32((n_node_shards - 1) * 2 * delta_capacity)
+                        + fb_t * jnp.uint32((n_node_shards - 1) * n_loc * w)
+                    )
+                elif sharded_ring:
+                    ex_words = jnp.uint32(
+                        n_groups * (n_node_shards - 1) * n_loc * w
+                    )
+                else:
+                    ex_words = jnp.uint32((n_node_shards - 1) * n_loc * w)
                 # Local row, psum'ed over node shards only: this shard's
                 # ring describes its own share chunk system-wide.
                 met_row = lax.psum(
                     tel_rings.flood_row(
                         arrivals_raw, newly_out, received - received_in,
                         degree, arrivals_lossless=arrivals_nl,
+                        exchange_words=ex_words,
                     ),
                     NODES_AXIS,
                 )
@@ -599,6 +844,8 @@ def build_sharded_runner(
                     axis_name=NODES_AXIS,
                 )
                 out = out + (tel_digest.write(state[dig_i], t, dval),)
+            if delta:
+                out = out + (didx_ring, dval_ring, dflag_ring, ectr)
             return out
 
         loop_out = lax.while_loop(cond, body, state)
@@ -623,6 +870,10 @@ def build_sharded_runner(
             outs = outs + (loop_out[8][None],)
         if dig:
             outs = outs + (loop_out[dig_i][None],)
+        if delta:
+            # Achieved-exchange counters, stacked per share-shard like
+            # the telemetry ring (uniform across node shards).
+            outs = outs + (loop_out[ex_i + 3][None],)
         return outs
 
     # Per bucket triple: rows (S, R) + idx/mask (S, R, C), all with the
@@ -651,13 +902,15 @@ def build_sharded_runner(
             P(),                  # t_start
             P(),                  # last_gen
             P(),                  # snap_ticks
-        ),
+        )
+        + ((P(NODES_AXIS, None),) if delta else ()),  # cut membership
         out_specs=(
             P(NODES_AXIS), P(NODES_AXIS), P(None, NODES_AXIS),
             P(None, SHARES_AXIS),
         )
         + ((P(SHARES_AXIS, None, None),) if tel else ())
-        + ((P(SHARES_AXIS, None),) if dig else ()),
+        + ((P(SHARES_AXIS, None),) if dig else ())
+        + ((P(SHARES_AXIS, None),) if delta else ()),  # exchange counters
         check_vma=False,
     )
     return jax.jit(mapped), n_share_shards * chunk_size
@@ -676,10 +929,14 @@ def _audit_mesh():
     return make_mesh(shards, shards, devices=devices[: shards * shards]), shards
 
 
-def _audit_spec_flood_runner(telemetry_on: bool = False):
+def _audit_spec_flood_runner(
+    telemetry_on: bool = False, exchange: str = "dense"
+):
     """Stage + compile-build the sharded flood runner on tiny shapes and
     hand the auditor the exact mapped callable the production driver
-    runs (shard_map + jit), uniform delay, sharded ring."""
+    runs (shard_map + jit), uniform delay, sharded ring; ``exchange``
+    "delta" audits the sparse frontier-delta path (both cond branches
+    trace, so the dense fallback is covered too)."""
     from p2p_gossip_tpu.models.topology import erdos_renyi
     from p2p_gossip_tpu.staticcheck.registry import AuditSpec
     from p2p_gossip_tpu.telemetry.schema import NUM_METRICS
@@ -691,15 +948,18 @@ def _audit_spec_flood_runner(telemetry_on: bool = False):
      churn_start, churn_end) = _stage_sharded_inputs(
         graph, None, 1, mesh, None, None
     )
-    (ring_mode, ell_args, delay_values, bucket_counts,
-     _extra) = _resolve_and_stage_ring(
+    (ring_mode, ell_args, delay_values, bucket_counts, _extra,
+     exchange_plan) = _resolve_and_stage_ring(
         "auto", uniform, ring, n_padded, mesh.shape[NODES_AXIS],
         bitmask.num_words(chunk), ell_idx, ell_delay, ell_mask, block=block,
+        exchange=exchange,
     )
+    exchange_mode, need, capacity, _ = exchange_plan
     runner, pass_size = build_sharded_runner(
         mesh, n_padded, ring, chunk, horizon, block, uniform, 0, None,
         ring_mode=ring_mode, delay_values=delay_values,
         bucket_counts=bucket_counts, telemetry_on=telemetry_on,
+        exchange_mode=exchange_mode, delta_capacity=capacity,
     )
     origins = np.zeros(pass_size, dtype=np.int32)
     gen_ticks = np.full(pass_size, horizon, dtype=np.int32)
@@ -709,12 +969,17 @@ def _audit_spec_flood_runner(telemetry_on: bool = False):
         # Stacked per-shard digest rings are (1, horizon) uint32 — the
         # horizon is a declared minor width, like NUM_METRICS.
         words = words + (NUM_METRICS, horizon)
+    args = (
+        ell_args, degree, churn_start, churn_end, origins, gen_ticks,
+        np.int32(0), np.int32(0), np.zeros((0,), dtype=np.int32),
+    )
+    if exchange_mode == "delta":
+        args = args + (need,)
+        # Delta buffers (capacity minor dim) and the (1, 8) counter row.
+        words = words + (capacity, 8)
     return AuditSpec(
         fn=runner,
-        args=(
-            ell_args, degree, churn_start, churn_end, origins, gen_ticks,
-            np.int32(0), np.int32(0), np.zeros((0,), dtype=np.int32),
-        ),
+        args=args,
         integer_only=True,
         bitmask_words=words,
     )
@@ -729,6 +994,10 @@ register_entry(
 register_entry(
     "parallel.engine_sharded.flood_runner[telemetry]",
     spec=lambda: _audit_spec_flood_runner(telemetry_on=True),
+)
+register_entry(
+    "parallel.engine_sharded.flood_runner[delta]",
+    spec=lambda: _audit_spec_flood_runner(exchange="delta"),
 )
 
 
@@ -750,6 +1019,7 @@ def run_sharded_sim(
     ring_mode: str = "auto",
     connect_tick: int = 0,
     bucket_min_rows: int = 2048,
+    exchange: str = "dense",
 ) -> NodeStats:
     """Drop-in counterpart of run_sync_sim/run_event_sim on a device mesh:
     identical per-node counters, any number of shares — including under a
@@ -776,7 +1046,15 @@ def run_sharded_sim(
     ``ring_mode`` selects the history-ring layout (module docstring):
     "replicated", "sharded", or "auto" (default); counters are bitwise
     identical either way, and the resolved choice is reported in
-    ``stats.extra['ring']`` with its per-chip byte cost."""
+    ``stats.extra['ring']`` with its per-chip byte cost.
+
+    ``exchange`` selects the cross-shard frontier exchange: "dense"
+    (slice all_gathers, the default), "delta" (sparse frontier-delta
+    buffers over the cached cut structure — forces the sharded ring,
+    bitwise-identical counters), or "auto" (delta whenever the ring is
+    sharded across >1 node shards). The resolved path, its modeled
+    per-tick traffic, and the achieved counters land in
+    ``stats.extra['exchange']``."""
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
     (ell_idx, ell_delay, ell_mask, degree, ring, uniform, n_padded, block,
      churn_start, churn_end) = _stage_sharded_inputs(
@@ -784,12 +1062,14 @@ def run_sharded_sim(
     )
     boundaries = filter_snapshot_boundaries(snapshot_ticks, horizon_ticks)
     snap_ticks_arr = np.asarray(boundaries, dtype=np.int32)
-    (ring_mode, ell_args, delay_values, bucket_counts,
-     ring_extra) = _resolve_and_stage_ring(
+    (ring_mode, ell_args, delay_values, bucket_counts, ring_extra,
+     exchange_plan) = _resolve_and_stage_ring(
         ring_mode, uniform, ring, n_padded, mesh.shape[NODES_AXIS],
         bitmask.num_words(chunk_size), ell_idx, ell_delay, ell_mask,
-        block=block, bucket_min_rows=bucket_min_rows,
+        block=block, bucket_min_rows=bucket_min_rows, exchange=exchange,
     )
+    exchange_mode, need, capacity, exchange_extra = exchange_plan
+    delta_on = exchange_mode == "delta"
     tel = telemetry.rings_enabled()
     runner, pass_size = build_sharded_runner(
         mesh, n_padded, ring, chunk_size, horizon_ticks, block, uniform,
@@ -797,9 +1077,12 @@ def run_sharded_sim(
         loss.static_cfg if loss is not None else None,
         ring_mode=ring_mode, delay_values=delay_values,
         connect_tick=connect_tick, bucket_counts=bucket_counts,
-        telemetry_on=tel,
+        telemetry_on=tel, exchange_mode=exchange_mode,
+        delta_capacity=capacity,
     )
     n_share_shards = mesh.shape[SHARES_AXIS]
+    exch_counters = np.zeros(3, dtype=np.int64)  # used, ovf, fallback
+    exch_ticks = 0
 
     received = np.zeros(n_padded, dtype=np.int64)
     sent = np.zeros(n_padded, dtype=np.int64)
@@ -853,11 +1136,19 @@ def run_sharded_sim(
                 out = runner(
                     ell_args, degree, churn_start, churn_end,
                     origins, gen_ticks, t_start, last_gen, snap_ticks_arr,
+                    *((need,) if delta_on else ()),
                 )
+            r, s, sn = out[0], out[1], out[2]
             if tel:
-                r, s, sn, _, met, dstream = out
-            else:
-                r, s, sn, _ = out
+                met, dstream = out[4], out[5]
+            if delta_on:
+                ec = np.asarray(out[-1], dtype=np.uint64)  # (shards, 8)
+                exch_counters[0] += int(
+                    bitmask.combine_u64(ec[:, 0], ec[:, 1]).sum()
+                )
+                exch_counters[1] += int(ec[:, 2].sum())
+                exch_counters[2] += int(ec[:, 3].sum())
+                exch_ticks += int(ec[:, 4].sum())
             with telemetry.span("d2h", chunk=ci):
                 received += np.asarray(r, dtype=np.int64)
                 sent += np.asarray(s, dtype=np.int64)
@@ -903,6 +1194,15 @@ def run_sharded_sim(
         degree=graph.degree.astype(np.int64),
     )
     stats.extra["ring"] = ring_extra
+    stats.extra["exchange"] = (
+        _achieved_exchange_report(
+            exchange_extra, exch_counters, exch_ticks,
+            mesh.shape[NODES_AXIS], n_padded // mesh.shape[NODES_AXIS],
+            bitmask.num_words(chunk_size), capacity,
+        )
+        if delta_on
+        else exchange_extra
+    )
     if snapshot_ticks is not None:
         stats.extra["snapshots"] = assemble_snapshots(
             schedule, churn, boundaries, snap_received[:, : graph.n],
@@ -924,6 +1224,7 @@ def run_sharded_flood_coverage(
     loss=None,
     ring_mode: str = "auto",
     bucket_min_rows: int = 2048,
+    exchange: str = "dense",
 ):
     """Flood coverage-time experiment on the device mesh — the BASELINE
     north-star metric (time-to-99% coverage at 1M nodes on a v5e-8 mesh)
@@ -947,12 +1248,14 @@ def run_sharded_flood_coverage(
      churn_start, churn_end) = _stage_sharded_inputs(
         graph, ell_delays, constant_delay, mesh, block, churn
     )
-    (ring_mode, ell_args, delay_values, bucket_counts,
-     ring_extra) = _resolve_and_stage_ring(
+    (ring_mode, ell_args, delay_values, bucket_counts, ring_extra,
+     exchange_plan) = _resolve_and_stage_ring(
         ring_mode, uniform, ring, n_padded, mesh.shape[NODES_AXIS],
         bitmask.num_words(chunk_size), ell_idx, ell_delay, ell_mask,
-        block=block, bucket_min_rows=bucket_min_rows,
+        block=block, bucket_min_rows=bucket_min_rows, exchange=exchange,
     )
+    exchange_mode, need, capacity, exchange_extra = exchange_plan
+    delta_on = exchange_mode == "delta"
     _rss_log("ring staged")
     tel = telemetry.rings_enabled()
     runner, pass_size = build_sharded_runner(
@@ -960,6 +1263,7 @@ def run_sharded_flood_coverage(
         0, loss.static_cfg if loss is not None else None, True, cov_slots,
         ring_mode=ring_mode, delay_values=delay_values,
         bucket_counts=bucket_counts, telemetry_on=tel,
+        exchange_mode=exchange_mode, delta_capacity=capacity,
     )
     o, g_ticks = sched.padded(pass_size, horizon_ticks)
     _rss_log("runner built")
@@ -970,10 +1274,12 @@ def run_sharded_flood_coverage(
             ell_args, degree, churn_start, churn_end,
             o, g_ticks, np.int32(0), np.int32(0),
             np.zeros((0,), dtype=np.int32),
+            *((need,) if delta_on else ()),
         )
     digest_head = None
+    r, snt, cov = out[0], out[1], out[3]
     if tel:
-        r, snt, _, cov, met, dstream = out
+        met, dstream = out[4], out[5]
         met_np = np.asarray(met)
         dig_np = np.asarray(dstream)
         for k in range(n_share_shards):
@@ -989,8 +1295,6 @@ def run_sharded_flood_coverage(
             )
             if k == 0 and nz.size:
                 digest_head = int(dig_np[0][nz[-1]])
-    else:
-        r, snt, _, cov = out
     _rss_log("runner executed")
     generated = effective_generated(sched, horizon_ticks, churn)
     received = np.asarray(r, dtype=np.int64)[: graph.n]
@@ -1021,4 +1325,17 @@ def run_sharded_flood_coverage(
     )
     stats.extra["coverage"] = coverage
     stats.extra["ring"] = ring_extra
+    if delta_on:
+        ec = np.asarray(out[-1], dtype=np.uint64)  # (shards, 8)
+        counters = (
+            int(bitmask.combine_u64(ec[:, 0], ec[:, 1]).sum()),
+            int(ec[:, 2].sum()),
+            int(ec[:, 3].sum()),
+        )
+        exchange_extra = _achieved_exchange_report(
+            exchange_extra, counters, int(ec[:, 4].sum()),
+            mesh.shape[NODES_AXIS], n_padded // mesh.shape[NODES_AXIS],
+            bitmask.num_words(chunk_size), capacity,
+        )
+    stats.extra["exchange"] = exchange_extra
     return stats, coverage

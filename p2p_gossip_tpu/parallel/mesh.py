@@ -4,8 +4,14 @@ The scale axis of the reference is one CPU core; ours is a
 ``jax.sharding.Mesh`` over TPU chips. Two logical axes:
 
 - ``nodes``  — partitions graph rows (adjacency, seen-bitmask, counters);
-  the per-tick frontier exchange `all_gather`s newly-frontiers along it,
-  riding ICI.
+  the per-tick frontier exchange rides ICI along it. Two wire formats:
+  the **dense** path `all_gather`s full state slices (one per delay
+  group), the **delta** path ships fixed-capacity sparse
+  (word-index, word-value) buffers via `all_to_all`/`all_gather` and
+  falls back to a dense gather on capacity overflow
+  (`parallel/exchange.py`). The shared traffic model both the cost
+  observatory and `bench.py` price against is
+  `exchange.modeled_exchange_words_per_tick`.
 - ``shares`` — partitions share chunks (independent work, embarrassingly
   parallel); counters `psum` along it at the end.
 """
@@ -158,7 +164,11 @@ def make_multihost_mesh(
       embarrassingly parallel — zero per-tick communication, one counter
       ``psum`` at the end — so the slow network carries almost nothing;
     - the **nodes** axis stays inside each process's local devices (a
-      slice's ICI): it carries the per-tick frontier ``all_gather``.
+      slice's ICI): it carries the per-tick frontier exchange — the
+      dense state-slice ``all_gather``s or, under ``exchange="delta"``,
+      the sparse frontier-delta ``all_to_all``/``all_gather`` buffers
+      (see ``exchange.modeled_exchange_words_per_tick`` for the bytes
+      each path puts on the wire).
 
     Defaults: one share shard per process, nodes axis = one process's
     local devices (``process_is_granule`` — on a multi-host slice each

@@ -35,6 +35,21 @@ Like the flood engine, the history ring has a ``ring_mode``:
 ``"auto"`` picks sharded for fanout push always, for anti-entropy under
 uniform delay (same traffic, 1/shards HBM), and otherwise replicated
 until the ring would exceed RING_REPLICATED_MAX_BYTES per chip.
+
+On the sharded ring, ``exchange="delta"`` replaces the anti-entropy
+read-time slice all_gathers with the sparse frontier-delta exchange
+(`parallel/exchange.py`). The ring holds cumulative seen-state, so each
+tick's delta vs the previous slot is small in steady state; one
+all_gather of fixed-capacity (idx, val) buffers moves it (partner picks
+are global-random — every shard needs every delta, so all_to_all buys
+nothing here), and each shard maintains L per-delay MIRRORS of the
+global (t - d) slices, advanced incrementally by OR-ing the received
+deltas — exact because seen is OR-monotone. A capacity overflow
+anywhere raises the slot's mesh-uniform flag and the affected mirror
+advance dense-resets from a full slice all_gather (the hist slot IS the
+cumulative slice, so the reset is exact). Bitwise-identical counters on
+every path; fanout push's sharded ring reads no remote state at all
+("none" — nothing to compress).
 """
 
 from __future__ import annotations
@@ -93,6 +108,8 @@ def build_partnered_runner(
     ring_mode: str = "replicated",
     delay_values: tuple | None = None,
     telemetry_on: bool = False,
+    exchange_mode: str = "dense",
+    delta_capacity: int = 0,
 ):
     """Compile the per-pass runner for a random-partner protocol over the
     mesh. Memoized on mesh/shapes like engine_sharded.build_sharded_runner.
@@ -104,7 +121,16 @@ def build_partnered_runner(
     ``telemetry_on`` (static) carries a (horizon, NUM_METRICS) metric
     ring through the round loop (rows psum'ed over node shards; one ring
     per share-shard, stacked like the counters) — one extra trailing
-    output."""
+    output.
+
+    ``exchange_mode`` "delta" (sharded ring, anti-entropy protocols
+    only) swaps the per-delay slice all_gathers for the sparse
+    seen-state delta exchange (module docstring): one fixed-capacity
+    all_gather of changed-word buffers per round plus L incrementally
+    advanced mirrors of the delayed global slices — bitwise-identical
+    counters, one extra trailing (1, 8) uint32 counter output
+    [used_entries_lo, used_entries_hi, overflow_write_ticks,
+    dense_fallback_reads, exchange_ticks, 0, 0, 0] per share-shard."""
     if protocol not in ("pushpull", "pull", "pushk"):
         raise ValueError(f"unknown protocol {protocol!r}")
     if fanout < 1:
@@ -122,6 +148,21 @@ def build_partnered_runner(
     anti = protocol in ("pushpull", "pull")
     sharded_ring = ring_mode == "sharded"
     hist_rows = (n_padded // n_node_shards) if sharded_ring else n_padded
+    delta = exchange_mode == "delta"
+    if delta and not (sharded_ring and anti):
+        raise ValueError(
+            "exchange_mode='delta' needs the sharded ring and an "
+            "anti-entropy protocol (fanout push reads no remote state)"
+        )
+    if delta and delta_capacity < 1:
+        raise ValueError(f"delta_capacity must be >= 1, got {delta_capacity}")
+    if delta and ring_size < 2:
+        # The per-tick delta compares against the previous slot, which
+        # must survive this tick's write.
+        raise ValueError("exchange_mode='delta' needs ring_size >= 2")
+    if delta:
+        from p2p_gossip_tpu.parallel import exchange as exch
+    n_groups = len(delay_values) if delay_values else 1
 
     def pass_fn(
         ell_idx, ell_delay, degree, churn_start, churn_end,
@@ -155,9 +196,39 @@ def build_partnered_runner(
         dig_i = 6 + (1 if tel else 0)
         if dig:
             state = state + (tel_digest.init(horizon),)           # digests
+        ex_i = 6 + (1 if tel else 0) + (1 if dig else 0)
+        if delta:
+            # Every shard needs every delta (global-random partners):
+            # one buffer per shard, all rows candidates, self included.
+            need_all = jnp.ones((n_loc, 1), dtype=jnp.bool_)
+            state = state + (
+                # Per-delay mirrors of the global (t - d) seen slices —
+                # invariant at entry to body(t): mirrors[j] equals the
+                # all_gathered hist[(t - delay_values[j]) mod ring].
+                jnp.zeros(
+                    (len(delay_values), n_padded, w), dtype=jnp.uint32
+                ),
+                # Received-delta rings, slot-aligned with hist; axis 1
+                # is the source shard. idx -1 = empty.
+                jnp.full(
+                    (ring_size, n_node_shards, delta_capacity),
+                    -1, dtype=jnp.int32,
+                ),
+                jnp.zeros(
+                    (ring_size, n_node_shards, delta_capacity),
+                    dtype=jnp.uint32,
+                ),
+                jnp.zeros((ring_size,), dtype=jnp.bool_),  # overflow flags
+                # [used_lo, used_hi, overflow_writes, fallback_reads,
+                #  exchange_ticks, 0, 0, 0]
+                jnp.zeros((8,), dtype=jnp.uint32),
+            )
 
         def body(t, state):
             seen, hist, received, sent_lo, sent_hi, cov_hist = state[:6]
+            if delta:
+                (mirrors, didx_ring, dval_ring, dflag_ring,
+                 ectr) = state[ex_i:ex_i + 5]
             t = jnp.int32(t)
             if anti:
                 kidx = pick_index_jnp(node_ids, t, 0, degree, seed)
@@ -179,13 +250,18 @@ def build_partnered_runner(
                     my_old = loc_flat[slot * hist_rows + rows_l]
                     # Partner state: reassemble the (t - d) global slice
                     # per distinct delay value and select each node's
-                    # partner row from the slice its edge dictates.
+                    # partner row from the slice its edge dictates. The
+                    # delta path reads the incrementally-advanced
+                    # mirrors instead — no per-delay all_gather.
                     remote = jnp.zeros((n_loc, w), dtype=jnp.uint32)
-                    for dval in delay_values:
-                        f_d = lax.all_gather(
-                            hist[jnp.mod(t - dval, ring_size)],
-                            NODES_AXIS, axis=0, tiled=True,
-                        )
+                    for j, dval in enumerate(delay_values):
+                        if delta:
+                            f_d = mirrors[j]
+                        else:
+                            f_d = lax.all_gather(
+                                hist[jnp.mod(t - dval, ring_size)],
+                                NODES_AXIS, axis=0, tiled=True,
+                            )
                         remote = jnp.where(
                             (delay == dval)[:, None], f_d[partners], remote
                         )
@@ -311,12 +387,75 @@ def build_partnered_runner(
                 seen = seen | newly | gen_bits
                 exchange = newly | gen_bits           # hist holds frontier
             if sharded_ring:
+                if delta:
+                    # The previous slot's cumulative slice — read before
+                    # this tick's write (distinct slots: ring_size >= 2).
+                    prev = hist[jnp.mod(t - 1, ring_size)]
                 # Local write; reads reassemble at read time (or stay
                 # local entirely for fanout push).
                 hist = hist.at[jnp.mod(t, ring_size)].set(exchange)
             else:
                 full = lax.all_gather(exchange, NODES_AXIS, axis=0, tiled=True)
                 hist = hist.at[jnp.mod(t, ring_size)].set(full)
+            if delta:
+                # Write-time sparse exchange: the seen-state is
+                # cumulative, so this tick's delta vs the previous slot
+                # is exactly the words OR-advancing every mirror needs.
+                d_words = exchange & ~prev
+                cidx, cval, dcounts = exch.compress_deltas(
+                    d_words, need_all, delta_capacity
+                )
+                idx_recv = lax.all_gather(cidx, NODES_AXIS, axis=0, tiled=True)
+                val_recv = lax.all_gather(cval, NODES_AXIS, axis=0, tiled=True)
+                ovf = lax.psum(
+                    jnp.any(dcounts > delta_capacity).astype(jnp.int32),
+                    NODES_AXIS,
+                ) > 0
+                slot_w = jnp.mod(t, ring_size)
+                didx_ring = didx_ring.at[slot_w].set(idx_recv)
+                dval_ring = dval_ring.at[slot_w].set(val_recv)
+                dflag_ring = dflag_ring.at[slot_w].set(ovf)
+                # Advance each mirror to the slice next round reads:
+                # u = t + 1 - d. A flagged slot dense-resets from a full
+                # slice all_gather (the hist slot IS the cumulative
+                # slice — exact); otherwise OR in the slot's received
+                # deltas (an unwritten slot holds -1 indices -> no-op,
+                # matching the all-zero pre-history slices).
+                new_mirrors = []
+                fb_t = jnp.zeros((), dtype=jnp.uint32)
+                for j, dv in enumerate(delay_values):
+                    slot_u = jnp.mod(t + 1 - dv, ring_size)
+
+                    def dense_m(_, s=slot_u):
+                        return lax.all_gather(
+                            hist[s], NODES_AXIS, axis=0, tiled=True
+                        )
+
+                    def sparse_m(_, s=slot_u, mj=mirrors[j]):
+                        return mj | exch.scatter_deltas(
+                            didx_ring[s], dval_ring[s], n_loc, w, n_padded
+                        )
+
+                    new_mirrors.append(
+                        lax.cond(
+                            dflag_ring[slot_u], dense_m, sparse_m,
+                            operand=None,
+                        )
+                    )
+                    fb_t = fb_t + dflag_ring[slot_u].astype(jnp.uint32)
+                mirrors = jnp.stack(new_mirrors)
+                used_t = lax.psum(
+                    jnp.sum(jnp.minimum(dcounts, delta_capacity)),
+                    NODES_AXIS,
+                ).astype(jnp.uint32)
+                u_lo, u_hi = bitmask.add_u64(ectr[0], ectr[1], used_t)
+                ectr = jnp.stack((
+                    u_lo, u_hi,
+                    ectr[2] + ovf.astype(jnp.uint32),
+                    ectr[3] + fb_t,
+                    ectr[4] + jnp.uint32(1),
+                    ectr[5], ectr[6], ectr[7],
+                ))
             if record_coverage:
                 cov = lax.psum(
                     bitmask.coverage_per_slot(seen, chunk_size), NODES_AXIS
@@ -326,6 +465,22 @@ def build_partnered_runner(
                 )
             out = (seen, hist, received, sent_lo, sent_hi, cov_hist)
             if tel:
+                # Per-chip state-slice exchange words received this
+                # round (schema docstring; push-direction all_to_all
+                # traffic is not included); psum'ed into the mesh total
+                # with the rest of the row.
+                if delta:
+                    ex_words = (
+                        jnp.uint32((n_node_shards - 1) * 2 * delta_capacity)
+                        + fb_t * jnp.uint32((n_node_shards - 1) * n_loc * w)
+                    )
+                elif sharded_ring:
+                    ex_words = jnp.uint32(
+                        n_groups * (n_node_shards - 1) * n_loc * w
+                        if anti else 0
+                    )
+                else:
+                    ex_words = jnp.uint32((n_node_shards - 1) * n_loc * w)
                 pc_newbits = bitmask.popcount_rows(newbits)
                 met_row = lax.psum(
                     tel_rings.row(
@@ -335,6 +490,7 @@ def build_partnered_runner(
                         msgs_gathered=gathered,
                         or_work=tel_rings.u32sum(sent_add),
                         loss_dropped=dropped,
+                        exchange_words=ex_words,
                     ),
                     NODES_AXIS,
                 )
@@ -349,6 +505,8 @@ def build_partnered_runner(
                     sent_hi=sent_hi,
                 )
                 out = out + (tel_digest.write(state[dig_i], t, dval),)
+            if delta:
+                out = out + (mirrors, didx_ring, dval_ring, dflag_ring, ectr)
             return out
 
         loop_out = lax.fori_loop(0, horizon, body, state)
@@ -360,6 +518,9 @@ def build_partnered_runner(
             out = out + (loop_out[6][None],)
         if dig:
             out = out + (loop_out[dig_i][None],)
+        if delta:
+            # Achieved-exchange counters (uniform across node shards).
+            out = out + (loop_out[ex_i + 4][None],)
         return out
 
     mapped = shard_map(
@@ -382,7 +543,8 @@ def build_partnered_runner(
             P(SHARES_AXIS, None, None),  # coverage (psum'ed over nodes)
         )
         + ((P(SHARES_AXIS, None, None),) if tel else ())
-        + ((P(SHARES_AXIS, None),) if dig else ()),
+        + ((P(SHARES_AXIS, None),) if dig else ())
+        + ((P(SHARES_AXIS, None),) if delta else ()),  # exchange counters
         check_vma=False,
     )
     return jax.jit(mapped), n_share_shards * chunk_size
@@ -390,12 +552,15 @@ def build_partnered_runner(
 
 # --- staticcheck audit spec (p2p_gossip_tpu/staticcheck/) -----------------
 
-def _audit_spec_partnered_runner(protocol: str, telemetry_on: bool = False):
+def _audit_spec_partnered_runner(
+    protocol: str, telemetry_on: bool = False, exchange: str = "dense"
+):
     """Stage + build the sharded partnered runner on tiny shapes (same
     mesh policy as the flood audit spec). The u64 ``sent`` counter halves
     come back as (n_share_shards, n_padded) uint32 stacks, so the allowed
     uint32 minor dims include the padded row count alongside the bitmask
-    word width."""
+    word width. ``exchange`` "delta" audits the sparse seen-delta path
+    (sharded ring; both mirror-advance cond branches trace)."""
     from p2p_gossip_tpu.models.topology import erdos_renyi
     from p2p_gossip_tpu.parallel.engine_sharded import _audit_mesh
     from p2p_gossip_tpu.staticcheck.registry import AuditSpec
@@ -411,12 +576,26 @@ def _audit_spec_partnered_runner(protocol: str, telemetry_on: bool = False):
     )
     n_padded = ell_idx.shape[0]
     churn_start, churn_end = _padded_churn(None, n_padded, n_node_shards)
-    runner, pass_size = build_partnered_runner(
-        mesh, protocol, n_padded, ring, chunk, horizon,
-        2 if protocol == "pushk" else 1,
-        (1 << 20, 7), False, ring_mode="replicated",
-        telemetry_on=telemetry_on,
-    )
+    capacity = 0
+    if exchange == "delta":
+        from p2p_gossip_tpu.parallel import exchange as exch
+
+        n_loc = n_padded // n_node_shards
+        w = bitmask.num_words(chunk)
+        capacity = exch.delta_capacity(n_loc, n_loc, w)
+        runner, pass_size = build_partnered_runner(
+            mesh, protocol, n_padded, ring, chunk, horizon, 1,
+            (1 << 20, 7), False, ring_mode="sharded", delay_values=(1,),
+            telemetry_on=telemetry_on, exchange_mode="delta",
+            delta_capacity=capacity,
+        )
+    else:
+        runner, pass_size = build_partnered_runner(
+            mesh, protocol, n_padded, ring, chunk, horizon,
+            2 if protocol == "pushk" else 1,
+            (1 << 20, 7), False, ring_mode="replicated",
+            telemetry_on=telemetry_on,
+        )
     origins = np.zeros(pass_size, dtype=np.int32)
     gen_ticks = np.full(pass_size, horizon, dtype=np.int32)
     gen_ticks[:2] = 0
@@ -425,6 +604,9 @@ def _audit_spec_partnered_runner(protocol: str, telemetry_on: bool = False):
         # Stacked per-shard digest rings are (1, horizon) uint32 — the
         # horizon is a declared minor width, like NUM_METRICS.
         words = words + (NUM_METRICS, horizon)
+    if exchange == "delta":
+        # Delta buffers (capacity minor dim) and the (1, 8) counter row.
+        words = words + (capacity, 8)
     return AuditSpec(
         fn=runner,
         args=(
@@ -454,6 +636,10 @@ register_entry(
     "parallel.protocols_sharded.pushk_runner[telemetry]",
     spec=lambda: _audit_spec_partnered_runner("pushk", telemetry_on=True),
 )
+register_entry(
+    "parallel.protocols_sharded.pushpull_runner[delta]",
+    spec=lambda: _audit_spec_partnered_runner("pushpull", exchange="delta"),
+)
 
 
 def run_sharded_partnered_sim(
@@ -474,6 +660,7 @@ def run_sharded_partnered_sim(
     checkpoint_every: int = 1,
     stop_after_chunks: int | None = None,
     ring_mode: str = "auto",
+    exchange: str = "dense",
 ):
     """Drop-in counterpart of run_pushpull_sim / run_pushk_sim on a device
     mesh: identical per-node counters for any mesh shape (the counter-based
@@ -489,6 +676,15 @@ def run_sharded_partnered_sim(
     checkpoint/resume contract (mesh shape is fingerprinted — a resume on
     a different mesh starts fresh; not combinable with
     ``record_coverage``).
+
+    ``exchange`` selects the anti-entropy cross-shard state exchange:
+    "dense" (per-delay slice all_gathers, the default), "delta" (sparse
+    seen-delta buffers + mirrors, module docstring — forces the sharded
+    ring, bitwise-identical counters), or "auto" (delta whenever the
+    anti-entropy ring is sharded across >1 node shards). Fanout push
+    reads no remote state on the sharded ring, so "delta" degrades to
+    that free path. Resolved mode, modeled traffic, and achieved
+    counters land in ``stats.extra['exchange']``.
     """
     if protocol not in ("pushpull", "pull", "pushk"):
         raise ValueError(f"unknown protocol {protocol!r}")
@@ -515,6 +711,12 @@ def run_sharded_partnered_sim(
     # all_gather per round and can never miss a real delay.
     from p2p_gossip_tpu.parallel.engine_sharded import resolve_ring_mode
 
+    if exchange not in ("dense", "delta", "auto"):
+        raise ValueError(f"unknown exchange mode {exchange!r}")
+    anti = protocol in ("pushpull", "pull")
+    if exchange == "delta" and anti:
+        # The delta path compresses the sharded ring's read exchange.
+        ring_mode = "sharded"
     distinct = tuple(int(v) for v in np.unique(ell_delays))
     if ring_mode == "auto" and protocol == "pushk":
         # Fanout push reads only its own rows' history: the sharded ring
@@ -530,6 +732,45 @@ def run_sharded_partnered_sim(
         else None
     )
 
+    from p2p_gossip_tpu.parallel import exchange as exch_mod
+
+    if exchange == "auto":
+        exchange = (
+            "delta"
+            if anti and ring_mode == "sharded" and n_node_shards > 1
+            else "dense"
+        )
+    delta_on = exchange == "delta" and anti and ring_mode == "sharded"
+    w = bitmask.num_words(chunk_size)
+    n_loc = n_padded // n_node_shards
+    # Worst case every local row changes — the anti-entropy delta has no
+    # static cut to restrict it (partners are global-random).
+    capacity = (
+        exch_mod.delta_capacity(n_loc, n_loc, w, len(delay_values))
+        if delta_on else 0
+    )
+    dense_kind = (
+        ("dense" if anti else "none")
+        if ring_mode == "sharded" else "replicated"
+    )
+    exchange_extra = {
+        "mode": "delta" if delta_on else dense_kind,
+        "capacity": capacity,
+        "modeled_dense_words_per_tick": (
+            exch_mod.modeled_exchange_words_per_tick(
+                dense_kind, n_shards=n_node_shards, n_loc=n_loc, w=w,
+                delay_splits=len(delay_values) if delay_values else 1,
+            )
+        ),
+    }
+    if delta_on:
+        exchange_extra["modeled_delta_words_per_tick"] = (
+            exch_mod.modeled_exchange_words_per_tick(
+                "delta", n_shards=n_node_shards, n_loc=n_loc, w=w,
+                capacity=capacity,
+            )
+        )
+
     tel = telemetry.rings_enabled()
     runner, pass_size = build_partnered_runner(
         mesh, protocol, n_padded, ring, chunk_size, horizon_ticks,
@@ -537,6 +778,8 @@ def run_sharded_partnered_sim(
         loss.static_cfg if loss is not None else None,
         record_coverage,
         ring_mode=ring_mode, delay_values=delay_values, telemetry_on=tel,
+        exchange_mode="delta" if delta_on else "dense",
+        delta_capacity=capacity,
     )
     seed_arr = np.uint32(seed & 0xFFFFFFFF)
     n_share_shards = mesh.shape[SHARES_AXIS]
@@ -568,6 +811,8 @@ def run_sharded_partnered_sim(
     )
 
     cov_chunks = []
+    exch_counters = np.zeros(3, dtype=np.int64)  # used, ovf, fallback
+    exch_ticks = 0
     chunks = schedule.chunk(pass_size) or [schedule]
     for ci, chunk in checkpointed_chunks(chunks, checkpointer, stop_after_chunks):
         origins, gen_ticks = chunk.padded(pass_size, horizon_ticks)
@@ -580,8 +825,16 @@ def run_sharded_partnered_sim(
                 origins, gen_ticks, seed_arr,
             )
         digest_head = None
+        if delta_on:
+            ec = np.asarray(out[-1], dtype=np.uint64)  # (shards, 8)
+            exch_counters[0] += int(
+                bitmask.combine_u64(ec[:, 0], ec[:, 1]).sum()
+            )
+            exch_counters[1] += int(ec[:, 2].sum())
+            exch_counters[2] += int(ec[:, 3].sum())
+            exch_ticks += int(ec[:, 4].sum())
         if tel:
-            r, s_lo, s_hi, cov, met, dstream = out
+            r, s_lo, s_hi, cov, met, dstream = out[:6]
             met_np = np.asarray(met)
             dig_np = np.asarray(dstream)
             for k in range(n_share_shards):
@@ -595,7 +848,7 @@ def run_sharded_partnered_sim(
                 )
             digest_head = int(dig_np[0][-1])
         else:
-            r, s_lo, s_hi, cov = out
+            r, s_lo, s_hi, cov = out[:4]
         telemetry.emit_progress(
             f"parallel.protocols_sharded.{protocol}_runner",
             chunk=ci, chunks_total=len(chunks),
@@ -634,6 +887,16 @@ def run_sharded_partnered_sim(
         "slots": ring,
         "delay_splits": len(delay_values) if delay_values else 1,
     }
+    if delta_on:
+        from p2p_gossip_tpu.parallel.engine_sharded import (
+            _achieved_exchange_report,
+        )
+
+        exchange_extra = _achieved_exchange_report(
+            exchange_extra, exch_counters, exch_ticks,
+            n_node_shards, n_loc, w, capacity,
+        )
+    stats.extra["exchange"] = exchange_extra
     if record_coverage:
         return stats, np.concatenate(cov_chunks, axis=1)
     return stats

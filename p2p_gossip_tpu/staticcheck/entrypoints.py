@@ -19,4 +19,5 @@ def load_all() -> None:
     import p2p_gossip_tpu.ops.ell  # noqa: F401
     import p2p_gossip_tpu.ops.segment  # noqa: F401
     import p2p_gossip_tpu.parallel.engine_sharded  # noqa: F401
+    import p2p_gossip_tpu.parallel.exchange  # noqa: F401
     import p2p_gossip_tpu.parallel.protocols_sharded  # noqa: F401

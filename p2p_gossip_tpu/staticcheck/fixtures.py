@@ -28,11 +28,16 @@ Fixtures:
              digest ring is rank-1, so telemetry_off.py's T4 rule greps
              the OFF trace for the digest mix constants instead of
              scanning aval shapes
+  exchange   a delta-exchange compaction whose rank/keep computation
+             drifts through float32 — past ~2^24 cut rows the mantissa
+             rounds the cumsum and a capacity-C buffer silently keeps
+             the wrong words; the integer-only audit (J2) must flag the
+             inexact avals
 """
 
 from __future__ import annotations
 
-FIXTURES = ("f64", "recompile", "prng", "telemetry", "digest")
+FIXTURES = ("f64", "recompile", "prng", "telemetry", "digest", "exchange")
 
 
 def f64_fixture() -> dict:
@@ -185,6 +190,49 @@ def digest_fixture() -> dict:
     }
 
 
+def exchange_fixture() -> dict:
+    """Audit a deliberately-bad frontier-delta compaction: the write-side
+    rank computation (which of a shard's changed bitmask words fit the
+    fixed-capacity buffer) drifts through float32, the dtype leak that
+    would silently drop the wrong words once the cut-row count passes
+    the 2^24 mantissa. The integer-only audit (J2, same discipline the
+    real ``parallel.exchange.compress_deltas`` entry is registered
+    under) must flag the inexact avals."""
+    import jax.numpy as jnp
+
+    from p2p_gossip_tpu.staticcheck.jaxpr_audit import audit_entry
+    from p2p_gossip_tpu.staticcheck.registry import AuditEntry, AuditSpec
+
+    def bad_compress_deltas(changed, need):
+        # The seeded bug: per-row ranks via a float32 cumsum. Exact only
+        # below 2^24 rows — beyond it, equal ranks collide and the
+        # capacity cut keeps a wrong subset, bitwise-silently.
+        changed_rows = (changed != 0).any(axis=1) & need[:, 0]
+        ranks = jnp.cumsum(changed_rows.astype(jnp.float32))
+        keep = changed_rows & (ranks <= 8.0)
+        return jnp.where(keep[:, None], changed, jnp.uint32(0))
+
+    def spec():
+        return AuditSpec(
+            args=(
+                jnp.zeros((16, 2), dtype=jnp.uint32),
+                jnp.zeros((16, 1), dtype=jnp.bool_),
+            ),
+            integer_only=True,
+        )
+
+    entry = AuditEntry(
+        name="fixtures.exchange_bad_compress_deltas",
+        fn=bad_compress_deltas, spec=spec,
+    )
+    violations = audit_entry(entry)
+    return {
+        "fixture": "exchange",
+        "ok": not violations,  # must come back False
+        "violations": [v.as_dict() for v in violations],
+    }
+
+
 def run_fixture(name: str) -> dict:
     if name == "f64":
         return f64_fixture()
@@ -196,4 +244,6 @@ def run_fixture(name: str) -> dict:
         return telemetry_fixture()
     if name == "digest":
         return digest_fixture()
+    if name == "exchange":
+        return exchange_fixture()
     raise ValueError(f"unknown fixture {name!r}; valid: {FIXTURES}")

@@ -59,7 +59,7 @@ def _trace(fn, args, kwargs):
 def _ring_avals(closed) -> list[tuple]:
     """Shapes of metric-ring-like avals: uint32, rank >= 2, minor axis
     exactly NUM_METRICS — the ring's unmistakable signature (bitmask
-    word widths are powers of two >= 1; NUM_METRICS is 6)."""
+    word widths are powers of two >= 1; NUM_METRICS is 7)."""
     found = []
     for aval in _avals_of(closed):
         dtype = getattr(aval, "dtype", None)

@@ -2,7 +2,7 @@
 
 A metric ring is a ``(capacity, NUM_METRICS)`` uint32 array carried
 through a kernel's ``lax.while_loop`` / ``lax.scan`` state; each tick
-writes one row of six aggregate counters (schema.METRIC_COLUMNS) at its
+writes one row of aggregate counters (schema.METRIC_COLUMNS) at its
 tick index. The ring comes back as an ordinary kernel output and is
 harvested ONCE per chunk on the host (`emit_ring`) — no host callback,
 no sync, nothing per-tick crosses the jit boundary.
@@ -113,14 +113,17 @@ def row(
     msgs_gathered,
     or_work,
     loss_dropped,
+    exchange_words=0,
 ) -> jnp.ndarray:
-    """Assemble one ring row in METRIC_COLUMNS order."""
+    """Assemble one ring row in METRIC_COLUMNS order.
+    ``exchange_words`` defaults to 0 — single-device kernels have no
+    cross-shard state exchange to price."""
     return jnp.stack(
         [
             jnp.asarray(v, dtype=jnp.uint32)
             for v in (
                 frontier_bits, frontier_nodes, newly_infected,
-                msgs_gathered, or_work, loss_dropped,
+                msgs_gathered, or_work, loss_dropped, exchange_words,
             )
         ]
     )
@@ -132,12 +135,15 @@ def flood_row(
     received_delta: jnp.ndarray,  # (N,) first-time receives this tick
     degree: jnp.ndarray,          # (N,) int32
     arrivals_lossless=None,       # (N, W) the same gather with loss off
+    exchange_words=0,             # scalar: per-chip exchange words received
 ) -> jnp.ndarray:
     """The flood engines' per-tick row (shared by the solo, campaign and
     sharded tick bodies — all three call `_tick_body`-equivalent math).
     ``loss_dropped`` is the post-OR popcount delta between the lossless
     and actual gathers, exact in message *bits* (a bit dropped on every
-    one of its arriving edges counts once)."""
+    one of its arriving edges counts once). ``exchange_words`` is the
+    sharded runners' per-chip state-slice exchange traffic this tick
+    (schema docstring); solo engines leave the default 0."""
     pc_new = bitmask.popcount_rows(newly_out)
     gathered = total_bits(arrivals)
     dropped = (
@@ -152,6 +158,7 @@ def flood_row(
         msgs_gathered=gathered,
         or_work=u32sum(jnp.where(pc_new > 0, degree, 0)),
         loss_dropped=dropped,
+        exchange_words=exchange_words,
     )
 
 

@@ -49,6 +49,14 @@ per-engine semantics and the overflow bound):
                   share bits transmitted in digests/pushes this round
   loss_dropped    message bits lost in flight to the link-loss coin
                   this tick (0 when loss is off)
+  exchange_words  uint32 words of frontier/state slices received over
+                  the mesh interconnect this tick, totalled over node
+                  shards: the dense all_gathers (x delay splits on a
+                  sharded ring), the fixed delta all_to_all footprint
+                  plus any dense fallbacks (exchange="delta"), or 0 on
+                  a single shard. Push-direction digest traffic is NOT
+                  included — this column prices the state-slice
+                  exchange the dense/delta paths trade off.
 """
 
 from __future__ import annotations
@@ -62,6 +70,7 @@ METRIC_COLUMNS = (
     "msgs_gathered",
     "or_work",
     "loss_dropped",
+    "exchange_words",
 )
 NUM_METRICS = len(METRIC_COLUMNS)
 

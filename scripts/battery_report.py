@@ -414,6 +414,53 @@ def main() -> int:
                 "compile_s"]))
             print()
 
+    exch = by_stage.get("exchange")
+    if exch and exch["results"]:
+        legs = [r for r in exch["results"] if "exchange_mode" in r]
+        if legs:
+            print("## Frontier exchange: dense vs sparse delta "
+                  "(host-mesh rehearsal, legs bitwise-checked)\n")
+            print(md_table([
+                {
+                    "leg": f"{r.get('ring_mode')}/{r.get('exchange_mode')}",
+                    "nodes": r.get("nodes"),
+                    "topology": r.get("topology"),
+                    "edge_cut_pct": r.get("edge_cut_pct"),
+                    "modeled_dense_words_per_tick": (
+                        (r.get("exchange") or {})
+                        .get("modeled_dense_words_per_tick")
+                    ),
+                    "achieved_delta_words_per_tick": (
+                        (r.get("exchange") or {})
+                        .get("achieved_delta_words_per_tick")
+                    ),
+                    "delta_occupancy": (
+                        (r.get("exchange") or {}).get("delta_occupancy")
+                    ),
+                    "wall_s": r.get("wall_s"),
+                }
+                for r in legs
+            ], ["leg", "nodes", "topology", "edge_cut_pct",
+                "modeled_dense_words_per_tick",
+                "achieved_delta_words_per_tick", "delta_occupancy",
+                "wall_s"]))
+            dense = next((r for r in legs
+                          if r.get("exchange_mode") == "dense"
+                          and r.get("ring_mode") == "sharded"), None)
+            delta = next((r for r in legs
+                          if r.get("exchange_mode") == "delta"), None)
+            d_ex = (delta or {}).get("exchange") or {}
+            if dense is not None and d_ex.get(
+                    "achieved_delta_words_per_tick"):
+                ratio = (
+                    d_ex.get("modeled_dense_words_per_tick", 0)
+                    / d_ex["achieved_delta_words_per_tick"]
+                )
+                print(f"\ndense/delta wire ratio: {ratio:.2f}x "
+                      "(achieved delta words/tick vs the dense "
+                      "state-slice exchange on the same run)")
+            print()
+
     for stage, title in (
         ("scale1m", "1M north star (ER p=0.001, 64-share staging plan)"),
         ("scale1m_ba", "1M scale-free (BA m=3)"),

@@ -3,6 +3,7 @@
     python scripts/cost_report.py                  # human table
     python scripts/cost_report.py --json           # one JSON line on stdout
     python scripts/cost_report.py --only engine.sync
+    python scripts/cost_report.py --exchange       # + dense/delta crossover
     P2P_TELEMETRY=run.jsonl python scripts/cost_report.py   # + counter events
 
 Lowers and compiles every staticcheck-registered entry point on the
@@ -20,6 +21,13 @@ When the telemetry sink is enabled each figure is also emitted as a
 produced it. bench.py embeds the ``--only engine.sync --json`` output
 as its ``cost`` field. Platform is labeled — CPU figures are CPU
 figures, not chip numbers.
+
+``--exchange`` adds the frontier-exchange crossover: one sharded flood
+run per topology family under ``exchange="delta"``, reporting modeled
+dense vs achieved delta words/tick (the runner's on-device counters)
+priced by the shared traffic model
+`parallel.exchange.modeled_exchange_words_per_tick`, and which path
+wins at that scale.
 """
 
 from __future__ import annotations
@@ -161,16 +169,127 @@ def run_cost_report(only: str | None = None) -> dict:
     }
 
 
+#: Topology families the exchange crossover is priced on — one
+#: representative small instance each (CPU-cheap; the large-N numbers
+#: come from scripts/mesh_rehearsal.py --exchange).
+EXCHANGE_FAMILIES = ("erdos_renyi", "barabasi_albert", "watts_strogatz",
+                     "ring")
+
+
+def _exchange_family_graph(family: str, n: int, seed: int):
+    from p2p_gossip_tpu.models import topology
+
+    if family == "erdos_renyi":
+        return topology.erdos_renyi(n, 0.08, seed=seed)
+    if family == "barabasi_albert":
+        return topology.barabasi_albert(n, 2, seed=seed)
+    if family == "watts_strogatz":
+        return topology.watts_strogatz(n, 4, 0.1, seed=seed)
+    if family == "ring":
+        return topology.ring_graph(n)
+    raise ValueError(f"unknown family {family!r}")
+
+
+def run_exchange_report(
+    n: int = 96, horizon: int = 24, seed: int = 0,
+    families: tuple[str, ...] | None = None,
+) -> dict:
+    """Modeled-vs-achieved exchange words per tick, per topology family.
+
+    Runs the sharded flood runner once per family with the sparse
+    frontier-delta exchange and folds the runner's achieved-traffic
+    counters (``stats.extra['exchange']``) against the shared model
+    (`parallel.exchange.modeled_exchange_words_per_tick` — the same
+    formula bench.py and the engines price with). ``winner`` names the
+    cheaper path per family at this scale; the crossover is visible as
+    ``dense_over_delta`` (achieved dense words / achieved delta words —
+    > 1 means the delta path pays for itself)."""
+    import jax
+    import numpy as np
+
+    from p2p_gossip_tpu import telemetry
+    from p2p_gossip_tpu.models.generation import Schedule
+    from p2p_gossip_tpu.parallel.engine_sharded import run_sharded_sim
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+
+    n_dev = len(jax.devices())
+    if n_dev < 4:
+        return {"ok": True, "skipped": f"needs >= 4 devices, have {n_dev}"}
+    mesh = make_mesh(4, n_dev // 4)
+    rows = []
+    for family in families or EXCHANGE_FAMILIES:
+        graph = _exchange_family_graph(family, n, seed)
+        rng = np.random.default_rng(seed)
+        origins = rng.integers(0, graph.n, 8).astype(np.int32)
+        gens = (np.arange(8, dtype=np.int32) % 3) * 2
+        sched = Schedule(graph.n, origins, gens)
+        row: dict = {"family": family, "n": graph.n}
+        try:
+            stats = run_sharded_sim(
+                graph, sched, horizon, mesh, chunk_size=32,
+                exchange="delta",
+            )
+            ex = dict(stats.extra.get("exchange", {}))
+            dense = ex.get("modeled_dense_words_per_tick", 0)
+            achieved = ex.get("achieved_delta_words_per_tick", 0.0)
+            row.update(ex)
+            row["winner"] = (
+                "delta" if achieved and achieved < dense else "dense"
+            )
+            row["dense_over_delta"] = round(
+                dense / achieved, 3) if achieved else None
+            row["ok"] = True
+        except Exception as e:  # noqa: BLE001 - ledger must not die
+            row["ok"] = False
+            row["error"] = f"{type(e).__name__}: {e}"[:500]
+        rows.append(row)
+        if telemetry.enabled() and row.get("ok"):
+            for field in ("modeled_dense_words_per_tick",
+                          "modeled_delta_words_per_tick",
+                          "achieved_delta_words_per_tick"):
+                if row.get(field) is not None:
+                    telemetry.emit_counter(
+                        f"cost.exchange.{family}.{field}", row[field]
+                    )
+        log(f"exchange: {family}: "
+            + (f"dense={row.get('modeled_dense_words_per_tick')} "
+               f"delta~{row.get('achieved_delta_words_per_tick', 0):.1f} "
+               f"winner={row.get('winner')}"
+               if row.get("ok") else f"ERROR {row.get('error')}"))
+    return {
+        "ok": all(r.get("ok") for r in rows),
+        "platform": jax.devices()[0].platform,
+        "families": rows,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--json", action="store_true",
                     help="one JSON line on stdout instead of the table")
     ap.add_argument("--only", metavar="SUBSTR", default=None,
                     help="restrict to entries whose name contains SUBSTR")
+    ap.add_argument("--exchange", action="store_true",
+                    help="also price the dense/delta frontier exchange "
+                    "per topology family (modeled vs achieved words/tick)")
+    ap.add_argument("--exchange-only", action="store_true",
+                    help="skip the entry ledger; print just the exchange "
+                    "crossover JSON (bench.py's `exchange` field)")
+    ap.add_argument("--families", default=None, metavar="A,B",
+                    help="comma list of topology families for the "
+                    "exchange crossover (default: all)")
     args = ap.parse_args()
 
     _setup_backend()
+    fams = tuple(args.families.split(",")) if args.families else None
+    if args.exchange_only:
+        ex = run_exchange_report(families=fams)
+        print(json.dumps(ex))
+        return 0 if ex["ok"] else 1
     report = run_cost_report(only=args.only)
+    if args.exchange:
+        report["exchange"] = run_exchange_report(families=fams)
+        report["ok"] = report["ok"] and report["exchange"]["ok"]
 
     if args.json:
         print(json.dumps(report))
@@ -191,6 +310,26 @@ def main() -> int:
                   f"{r.get('bytes_accessed', 0):>12.0f} "
                   f"{r.get('jaxpr_eqns', 0):>6d} "
                   f"{r.get('compile_wall_s', 0):>9.3f}")
+        ex = report.get("exchange")
+        if ex is not None:
+            if "skipped" in ex:
+                print(f"exchange crossover: SKIPPED ({ex['skipped']})")
+            else:
+                print("exchange crossover (words/tick, "
+                      f"{ex['platform']}):")
+                for r in ex["families"]:
+                    if not r.get("ok"):
+                        print(f"  {r['family']:<16} ERROR: "
+                              f"{r.get('error')}")
+                        continue
+                    print(
+                        f"  {r['family']:<16} "
+                        f"dense={r.get('modeled_dense_words_per_tick')} "
+                        f"delta={r.get('achieved_delta_words_per_tick', 0):.1f} "
+                        f"(cap={r.get('capacity')}, "
+                        f"occ={r.get('delta_occupancy', 0):.3f}) "
+                        f"-> {r.get('winner')}"
+                    )
     return 0 if report["ok"] else 1
 
 

@@ -23,6 +23,12 @@ Pairs:
                    0 of the vmapped protocol campaign
   sync-sharded     solo ``engine.sync`` vs the shard_map flood runner on
                    a 2x2 mesh (skipped when fewer than 4 devices)
+  sync-delta       sharded flood runner with the dense state-slice
+                   exchange vs the same runner with the sparse
+                   frontier-delta exchange (``exchange="delta"``) —
+                   delta's OR-monotone merge must be bit-identical, so
+                   shard 0's digest streams must agree tick for tick
+                   (skipped when fewer than 4 devices)
 
 ``--inject-fault T`` is the bisector's self-test: after collecting each
 pair it flips one bit of the second stream's digest at tick T and
@@ -46,7 +52,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
-PAIRS = ("native-sync", "sync-campaign", "pushpull-campaign", "sync-sharded")
+PAIRS = (
+    "native-sync",
+    "sync-campaign",
+    "pushpull-campaign",
+    "sync-sharded",
+    "sync-delta",
+)
 
 
 def _setup_backend() -> None:
@@ -218,11 +230,46 @@ def pair_sync_sharded(args):
     return solo, sharded
 
 
+def pair_sync_delta(args):
+    import jax
+
+    if len(jax.devices()) < 4:
+        return None
+    from p2p_gossip_tpu.parallel.engine_sharded import run_sharded_sim
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+    from p2p_gossip_tpu.telemetry import compare
+
+    graph, sched = _workload(args)
+    mesh = make_mesh(2, 2)
+    dense_events = _capture_events(
+        lambda: run_sharded_sim(
+            graph, sched, args.horizon, mesh, chunk_size=args.chunk,
+            ring_mode="sharded",
+        )
+    )
+    delta_events = _capture_events(
+        lambda: run_sharded_sim(
+            graph, sched, args.horizon, mesh, chunk_size=args.chunk,
+            exchange="delta",
+        )
+    )
+    dense = compare.select_stream(
+        compare.digest_streams(dense_events), kernel="engine_sharded",
+        shard=0,
+    )
+    delta = compare.select_stream(
+        compare.digest_streams(delta_events), kernel="engine_sharded",
+        shard=0,
+    )
+    return dense, delta
+
+
 _PAIR_FNS = {
     "native-sync": pair_native_sync,
     "sync-campaign": pair_sync_campaign,
     "pushpull-campaign": pair_pushpull_campaign,
     "sync-sharded": pair_sync_sharded,
+    "sync-delta": pair_sync_delta,
 }
 
 

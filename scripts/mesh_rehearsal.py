@@ -16,6 +16,10 @@ Usage: python scripts/mesh_rehearsal.py [--nodes 100000] [--prob 0.001]
        [--shares 64] [--devices 8] [--skip-parity]
        [--protocol flood|pushpull|pull|pushk]   # partnered legs rehearse
        BASELINE config 5's anti-entropy on the same mesh/ring machinery
+       [--exchange dense|delta|ab]  # sharded-ring wire format; "ab" runs
+       both and reports achieved exchange words/tick side by side
+       [--partition]  # relabel nodes by the cached BFS-grown partition
+       so each shard owns one partition (minimal cross-shard edge cut)
 """
 
 import argparse
@@ -69,6 +73,22 @@ def main() -> int:
         "process, so the default W=128 pad multiplies every ring/frontier "
         "buffer x8 in one RSS — 1M scale-free (dmax 4517, ~40 GB "
         "full-width ELL) OOMs with it and needs e.g. --chunkSize 64",
+    )
+    ap.add_argument(
+        "--exchange", choices=("dense", "delta", "ab"), default="dense",
+        help="frontier-exchange wire format for the sharded-ring leg: "
+        "dense state-slice all_gathers (default), sparse frontier-delta "
+        "buffers (delta), or ab = run BOTH sharded legs and report the "
+        "achieved exchange words/tick side by side (the dense/delta "
+        "crossover measurement at rehearsal scale)",
+    )
+    ap.add_argument(
+        "--partition", action="store_true",
+        help="relabel node ids by the BFS-grown partition "
+        "(models/topology.partition_labels, one partition per mesh "
+        "shard) before running — minimizes the cross-shard edge cut the "
+        "delta exchange must ship; labels persist in the --cache npz "
+        "under the graph's build fingerprint",
     )
     ap.add_argument(
         "--skip-parity", action="store_true",
@@ -143,6 +163,43 @@ def main() -> int:
         f"graph: N={graph.n} edges={graph.num_edges} dmax={graph.max_degree}"
         f" ({time.perf_counter() - t0:.1f}s)"
     )
+
+    edge_cut_pct = None
+    if args.partition:
+        # Partition-centric layout: relabel so each mesh shard owns one
+        # BFS-grown partition. Labels are a pure function of the graph,
+        # so they persist in the same npz under the build fingerprint
+        # and the 1M partitioning pass runs once per graph build.
+        from p2p_gossip_tpu.models.topology import (
+            edge_cut,
+            load_or_compute_graph_aux,
+            partition_labels,
+            partition_order,
+            relabel_graph,
+            scale_graph_fingerprint,
+        )
+
+        fp = scale_graph_fingerprint(
+            args.topology, args.nodes, args.prob, args.baM, args.seed
+        )
+        t0 = time.perf_counter()
+        g_for_labels = graph
+        labels = load_or_compute_graph_aux(
+            args.cache, f"partition{args.devices}_s{args.seed}", fp,
+            lambda: partition_labels(
+                g_for_labels, args.devices, seed=args.seed
+            ),
+            log,
+        )
+        cut = edge_cut(graph, labels)
+        edge_cut_pct = round(100 * cut / max(graph.num_edges, 1), 2)
+        graph, _ = relabel_graph(graph, partition_order(labels))
+        log(
+            f"partition: {args.devices} parts, edge cut {cut}"
+            f"/{graph.num_edges} ({edge_cut_pct}%) "
+            f"({time.perf_counter() - t0:.1f}s)"
+        )
+
     delays = lognormal_delays(
         graph, mean_ticks=2.0, sigma=0.6, max_ticks=args.delay_max_ticks,
         seed=args.seed,
@@ -226,10 +283,10 @@ def main() -> int:
                 chunk_size=args.chunkSize or None,
             )
 
-        def run_mesh(ring_mode):
+        def run_mesh(ring_mode, exchange="dense"):
             return run_sharded_flood_coverage(
                 graph, origins, args.horizon, mesh, ell_delays=delays,
-                block=args.block, ring_mode=ring_mode,
+                block=args.block, ring_mode=ring_mode, exchange=exchange,
                 **({"chunk_size": args.chunkSize} if args.chunkSize else {}),
             )
     else:
@@ -261,11 +318,12 @@ def main() -> int:
                 **chunk_kw,
             )
 
-        def run_mesh(ring_mode):
+        def run_mesh(ring_mode, exchange="dense"):
             return run_sharded_partnered_sim(
                 graph, sched, args.horizon, mesh, protocol=args.protocol,
                 fanout=args.fanout, ell_delays=delays, seed=args.seed,
-                record_coverage=True, ring_mode=ring_mode, **chunk_kw,
+                record_coverage=True, ring_mode=ring_mode,
+                exchange=exchange, **chunk_kw,
             )
 
     cov_single = None
@@ -274,10 +332,21 @@ def main() -> int:
         stats_1, cov_single = run_single()
         log(f"single-device run: {time.perf_counter() - t0:.1f}s")
 
+    # Leg plan: the replicated-ring leg always runs (layout baseline);
+    # the sharded-ring leg runs dense, delta, or both ("ab" — the
+    # rehearsal-scale dense/delta crossover measurement). Every pair of
+    # legs is checked bitwise-equal below, so a delta leg is certified
+    # against whichever dense legs ran.
+    legs = [("replicated", "dense")]
+    if args.exchange in ("dense", "ab"):
+        legs.append(("sharded", "dense"))
+    if args.exchange in ("delta", "ab"):
+        legs.append(("sharded", "delta"))
+
     mesh_runs = []
-    for ring_mode in ("replicated", "sharded"):
+    for ring_mode, exchange in legs:
         t0 = time.perf_counter()
-        stats_m, cov_m = run_mesh(ring_mode)
+        stats_m, cov_m = run_mesh(ring_mode, exchange)
         wall = time.perf_counter() - t0
         ring = stats_m.extra["ring"]
         if args.protocol == "flood":
@@ -288,7 +357,7 @@ def main() -> int:
             # have different counter laws; their always-on check is the
             # cross-ring-mode bitwise equality below.)
             stats_m.check_conservation()
-        mesh_runs.append((ring_mode, stats_m, cov_m))
+        mesh_runs.append((f"{ring_mode}/{exchange}", stats_m, cov_m))
         parity = None
         if cov_single is not None:
             parity = bool(
@@ -322,18 +391,38 @@ def main() -> int:
             "coverage_final_min": int(np.asarray(cov_m)[-1].min()),
             "parity_vs_single_device": parity,
             "wall_s": round(wall, 1),
+            "exchange_mode": exchange,
+            "partitioned": bool(args.partition),
+            "edge_cut_pct": edge_cut_pct,
         }
-        log(f"{ring_mode}: ring {ring['bytes_per_chip']} B/chip, "
-            f"wall {wall:.1f}s, parity {parity}")
+        ex = stats_m.extra.get("exchange")
+        if ex is not None:
+            # The achieved-traffic report (parallel/engine_sharded.
+            # _achieved_exchange_report): modeled dense vs achieved
+            # delta words/tick, buffer occupancy, overflow counts.
+            row["exchange"] = ex
+        log(f"{ring_mode}/{exchange}: ring {ring['bytes_per_chip']} "
+            f"B/chip, wall {wall:.1f}s, parity {parity}"
+            + (f", exchange dense={ex.get('modeled_dense_words_per_tick')}"
+               f" delta~{ex.get('achieved_delta_words_per_tick', 0):.1f}"
+               f" words/tick (occ "
+               f"{ex.get('delta_occupancy', 0):.3f})"
+               if ex is not None and ex.get("mode") == "delta" else ""))
         print(json.dumps(row), flush=True)
 
-    # The two ring layouts must agree with each other bitwise — a check
-    # that costs nothing (both already ran) and survives --skip-parity,
-    # so even 1M rehearsals certify layout-independence.
-    (_, st_r, cov_r), (_, st_s, cov_s) = mesh_runs
-    assert st_r.equal_counts(st_s), "ring layouts disagree on counters"
-    assert np.array_equal(cov_r, cov_s), "ring layouts disagree on coverage"
-    log("ring layouts bitwise-equal (counters + coverage)")
+    # Every pair of legs must agree bitwise — a check that costs nothing
+    # (all already ran) and survives --skip-parity, so even 1M
+    # rehearsals certify layout- and wire-format-independence.
+    name0, st0, cov0 = mesh_runs[0]
+    for name_i, st_i, cov_i in mesh_runs[1:]:
+        assert st0.equal_counts(st_i), (
+            f"legs disagree on counters: {name0} vs {name_i}"
+        )
+        assert np.array_equal(cov0, cov_i), (
+            f"legs disagree on coverage: {name0} vs {name_i}"
+        )
+    log("mesh legs bitwise-equal (counters + coverage): "
+        + " == ".join(name for name, _, _ in mesh_runs))
     return 0
 
 

@@ -126,7 +126,7 @@ ART_DIR = os.path.join(REPO, "docs", "artifacts")
 
 STAGE_ORDER = (
     "bench", "protocols", "kernel", "bench_rep2", "bench_rep3",
-    "campaign", "staticcheck", "telemetry", "flightrec",
+    "campaign", "staticcheck", "telemetry", "flightrec", "exchange",
     "scale1m", "scale1m_ba", "sweep250", "profile", "scale1m_full",
 )
 
@@ -251,6 +251,21 @@ def stage_specs(args) -> dict:
                     py, os.path.join(SCRIPTS, "divergence.py"), "--json",
                     "--n", "64", "--shares", "3", "--horizon", "16",
                     "--with-cost", "engine.sync._run_chunk_while",
+                ],
+                "env": cpu,
+                "budget": args.stage_budget or 900,
+            },
+            "exchange": {
+                # Dense/delta frontier-exchange A/B at smoke shapes:
+                # three legs (replicated, sharded/dense, sharded/delta)
+                # must come back bitwise-equal, rows carry achieved
+                # exchange words/tick (mesh_rehearsal pins the CPU
+                # virtual mesh by design).
+                "argv": [
+                    py, os.path.join(SCRIPTS, "mesh_rehearsal.py"),
+                    "--nodes", "2000", "--prob", "0.01", "--shares", "32",
+                    "--horizon", "24", "--chunkSize", "32",
+                    "--exchange", "ab", "--partition",
                 ],
                 "env": cpu,
                 "budget": args.stage_budget or 900,
@@ -405,6 +420,25 @@ def stage_specs(args) -> dict:
             ],
             "env": sweep_env,
             "budget": args.stage_budget or 1800,
+        },
+        "exchange": {
+            # The dense/delta frontier-exchange crossover at rehearsal
+            # scale: BA 100K on the 8-virtual-device host mesh, all
+            # legs bitwise-checked, achieved exchange words/tick per
+            # wire format in the rows. mesh_rehearsal pins
+            # JAX_PLATFORMS=cpu by design (the delta exchange needs
+            # >= 4 mesh devices; a single-chip tunnel has one) — the
+            # rows are self-describing about that, so this stage is
+            # mechanics + crossover evidence, not a chip perf number.
+            # No --cache: the native BA build at 100K is seconds.
+            "argv": [
+                py, os.path.join(SCRIPTS, "mesh_rehearsal.py"),
+                "--topology", "ba", "--nodes", "100000", "--baM", "3",
+                "--shares", "64", "--horizon", "48", "--exchange", "ab",
+                "--partition", "--skip-parity",
+            ],
+            "env": sweep_env,
+            "budget": args.stage_budget or 3600,
         },
         "scale1m": {
             # The minimal-footprint rung of the 1M ladder: --chunk 64

@@ -2,7 +2,8 @@
 
     python scripts/staticcheck.py              # human report
     python scripts/staticcheck.py --json       # one JSON line on stdout
-    python scripts/staticcheck.py --fixture f64|recompile|prng|telemetry
+    python scripts/staticcheck.py --fixture f64|recompile|prng|
+                                           telemetry|digest|exchange
     python scripts/staticcheck.py --compile    # also lower+compile each
                                                # audited entry on the
                                                # default device (the
@@ -95,7 +96,7 @@ def main() -> int:
                     help="one JSON line on stdout instead of the human report")
     ap.add_argument("--fixture",
                     choices=("f64", "recompile", "prng", "telemetry",
-                             "digest"),
+                             "digest", "exchange"),
                     help="run one seeded regression fixture; exits non-zero "
                     "iff the analyzer (correctly) flags it")
     ap.add_argument("--lint-only", action="store_true",

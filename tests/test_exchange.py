@@ -1,0 +1,400 @@
+"""Partition-centric sharding + sparse frontier-delta exchange (ISSUE 11):
+the BFS-growth partitioner's size/determinism/cut contracts, the npz aux
+cache round-trip, the compress/scatter delta kernels' exactness, and the
+headline invariant — delta exchange bitwise-identical to dense across
+topology families, multi-delay rings, churn + loss, both sharded runners,
+and the flight-recorder digest streams, including forced-overflow ticks
+that exercise the dense fallback."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import p2p_gossip_tpu as pg
+from p2p_gossip_tpu.engine.event import run_event_sim
+from p2p_gossip_tpu.models.latency import lognormal_delays
+from p2p_gossip_tpu.models.topology import (
+    edge_cut,
+    load_graph_cache_aux,
+    load_or_compute_graph_aux,
+    partition_labels,
+    partition_order,
+    relabel_graph,
+    save_graph_cache,
+)
+from p2p_gossip_tpu.parallel import exchange as exch
+from p2p_gossip_tpu.parallel.engine_sharded import (
+    run_sharded_flood_coverage,
+    run_sharded_sim,
+)
+from p2p_gossip_tpu.parallel.mesh import make_mesh
+from p2p_gossip_tpu.parallel.protocols_sharded import (
+    run_sharded_partnered_sim,
+)
+
+
+def _cpu_mesh(n_node_shards, n_share_shards=1):
+    return make_mesh(n_node_shards, n_share_shards, devices=jax.devices("cpu"))
+
+
+# ---------------------------------------------------------------------------
+# Partitioner: sizes, determinism, cut quality, relabel alignment
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,parts", [(96, 4), (103, 8), (7, 3)])
+def test_partition_labels_sizes_match_shard_blocks(n, parts):
+    """Every partition holds exactly ceil(n/parts) rows (last takes the
+    remainder) — the alignment contract with pad_to_multiple's contiguous
+    node-shard blocks."""
+    g = pg.erdos_renyi(n, 0.1, seed=1)
+    labels = partition_labels(g, parts)
+    cap = -(-n // parts)
+    sizes = np.bincount(labels, minlength=parts)
+    for p in range(parts):
+        assert sizes[p] == max(0, min(cap, n - cap * p)), (p, sizes)
+
+
+def test_partition_labels_deterministic_and_seed_rotates():
+    g = pg.barabasi_albert(80, m=2, seed=2)
+    a = partition_labels(g, 4)
+    b = partition_labels(g, 4)
+    assert np.array_equal(a, b)
+    c = partition_labels(g, 4, seed=3)
+    assert a.shape == c.shape  # seed may move seeds; sizes stay pinned
+    assert np.array_equal(np.bincount(a), np.bincount(c))
+
+
+def test_partition_cuts_ring_into_contiguous_arcs():
+    """On a ring the BFS growth must find the trivial optimum-shape
+    answer: contiguous arcs, cut = one edge per boundary."""
+    n, parts = 64, 4
+    g = pg.ring_graph(n)
+    labels = partition_labels(g, parts)
+    assert edge_cut(g, labels) == parts
+    # Random labels cut ~half the ring's edges — the partitioner must
+    # beat that by an order of magnitude.
+    rng = np.random.default_rng(0)
+    rand = rng.integers(0, parts, n).astype(np.int32)
+    assert edge_cut(g, labels) < edge_cut(g, rand) / 4
+
+
+def test_partition_order_blocks_and_relabel_roundtrip():
+    g = pg.watts_strogatz(60, k=4, beta=0.1, seed=5)
+    labels = partition_labels(g, 4)
+    order = partition_order(labels)
+    # order[new_id] = old_id groups each partition into one contiguous
+    # block of new ids, in ascending partition order.
+    relabeled_labels = labels[order]
+    assert np.array_equal(relabeled_labels, np.sort(labels))
+    rg, inv = relabel_graph(g, order)
+    assert np.array_equal(inv[order], np.arange(g.n))
+    # Degree is label-invariant; edges survive the renumbering.
+    assert np.array_equal(rg.degree, g.degree[order])
+    assert rg.indices.shape == g.indices.shape
+
+
+def test_relabeled_flood_is_label_invariant():
+    """Gossip dynamics don't care about node ids: running on the
+    partition-relabeled graph and unrelabeling the counters reproduces
+    the original run bitwise."""
+    g = pg.erdos_renyi(72, 0.08, seed=6)
+    sched = pg.uniform_renewal_schedule(72, sim_time=4.0, tick_dt=0.01, seed=6)
+    base = run_event_sim(g, sched, 400)
+    labels = partition_labels(g, 4)
+    order = partition_order(labels)
+    rg, inv = relabel_graph(g, order)
+    r_sched = pg.Schedule(
+        sched.n_nodes, inv[sched.origins].astype(np.int32),
+        sched.gen_ticks.copy(),
+    )
+    rr = run_event_sim(rg, r_sched, 400)
+    assert np.array_equal(rr.received[inv], base.received)
+    assert np.array_equal(rr.sent[inv], base.sent)
+
+
+# ---------------------------------------------------------------------------
+# Aux npz cache: persisted derived orderings keyed by build fingerprint
+# ---------------------------------------------------------------------------
+
+def test_aux_cache_roundtrip_and_fingerprint_gate(tmp_path):
+    g = pg.erdos_renyi(40, 0.1, seed=0)
+    path = str(tmp_path / "g.npz")
+    labels = partition_labels(g, 4)
+    save_graph_cache(path, g, fp="fp-A", aux={"partition4_s0": labels})
+    assert np.array_equal(load_graph_cache_aux(path)["partition4_s0"], labels)
+
+    calls = []
+
+    def compute():
+        calls.append(1)
+        return partition_labels(g, 8)
+
+    logs = []
+    # Matching fingerprint: computed once, persisted, then cache-hit.
+    out1 = load_or_compute_graph_aux(path, "p8", "fp-A", compute, logs.append)
+    out2 = load_or_compute_graph_aux(path, "p8", "fp-A", compute, logs.append)
+    assert np.array_equal(out1, out2) and len(calls) == 1
+    # Existing aux keys survive the rewrite.
+    aux = load_graph_cache_aux(path)
+    assert set(aux) == {"partition4_s0", "p8"}
+    # Mismatched fingerprint: computes but must NOT persist.
+    load_or_compute_graph_aux(path, "px", "fp-B", compute, logs.append)
+    assert len(calls) == 2
+    assert "px" not in load_graph_cache_aux(path)
+    # No cache: always compute, never write.
+    load_or_compute_graph_aux("", "py", "fp-A", compute, logs.append)
+    assert len(calls) == 3
+
+
+# ---------------------------------------------------------------------------
+# Delta kernels: compress/scatter exactness + overflow + traffic model
+# ---------------------------------------------------------------------------
+
+def test_compress_scatter_roundtrip_exact():
+    rng = np.random.default_rng(4)
+    n_loc, w, n_dests, cap = 12, 3, 3, 40  # cap > n_loc*w: no overflow
+    changed = rng.integers(0, 2**32, (n_loc, w), dtype=np.uint32)
+    changed[rng.random((n_loc, w)) < 0.6] = 0  # sparse frontier
+    need = rng.random((n_loc, n_dests)) < 0.5
+    import jax.numpy as jnp
+
+    idx, val, counts = exch.compress_deltas(
+        jnp.asarray(changed), jnp.asarray(need), cap
+    )
+    idx, val, counts = np.asarray(idx), np.asarray(val), np.asarray(counts)
+    expect_counts = ((changed != 0) & need.T[:, :, None].repeat(
+        w, axis=2).transpose(0, 1, 2).reshape(n_dests, n_loc, w)).sum(
+        axis=(1, 2))
+    assert np.array_equal(counts, expect_counts)
+    for d in range(n_dests):
+        # Receiver view: this shard is source 0 of a 1-source scatter.
+        canvas = np.asarray(exch.scatter_deltas(
+            jnp.asarray(idx[d:d + 1]), jnp.asarray(val[d:d + 1]),
+            n_loc, w, n_loc,
+        ))
+        assert np.array_equal(canvas, np.where(need[:, d:d + 1], changed, 0))
+
+
+def test_compress_overflow_reports_true_counts():
+    import jax.numpy as jnp
+
+    n_loc, w, cap = 16, 2, 8
+    changed = np.arange(1, n_loc * w + 1, dtype=np.uint32).reshape(n_loc, w)
+    need = np.ones((n_loc, 1), dtype=bool)
+    idx, val, counts = exch.compress_deltas(
+        jnp.asarray(changed), jnp.asarray(need), cap
+    )
+    assert int(counts[0]) == n_loc * w  # true count, beyond capacity
+    # The kept prefix is exact: first `cap` candidates in word order.
+    assert np.array_equal(np.asarray(idx[0]), np.arange(cap))
+    assert np.array_equal(np.asarray(val[0]), changed.reshape(-1)[:cap])
+
+
+def test_modeled_exchange_words_formula():
+    kw = dict(n_shards=8, n_loc=100, w=4)
+    assert exch.modeled_exchange_words_per_tick("none", **kw) == 0
+    assert exch.modeled_exchange_words_per_tick("replicated", **kw) == 7 * 400
+    assert exch.modeled_exchange_words_per_tick(
+        "dense", delay_splits=3, **kw) == 3 * 7 * 400
+    assert exch.modeled_exchange_words_per_tick(
+        "delta", capacity=24, **kw) == 7 * 48
+    assert exch.modeled_exchange_words_per_tick(
+        "dense", n_shards=1, n_loc=100, w=4) == 0
+    with pytest.raises(ValueError):
+        exch.modeled_exchange_words_per_tick("bogus", **kw)
+
+
+def test_delta_capacity_halves_dense_traffic():
+    # No-overflow tick ships 2*capacity words <= dense n_loc*w words / 2.
+    for worst_rows, n_loc, w, splits in [(500, 64, 4, 1), (3, 64, 4, 2),
+                                         (1, 2, 1, 1)]:
+        cap = exch.delta_capacity(worst_rows, n_loc, w, splits)
+        assert cap % 8 == 0 and cap >= 8
+        if n_loc * w >= 32:
+            assert 2 * cap <= splits * n_loc * w
+
+
+def test_plan_flood_exchange_cut_structure():
+    g = pg.ring_graph(16)
+    labels = partition_labels(g, 4)
+    rg, _ = relabel_graph(g, partition_order(labels))
+    ell_idx, ell_mask = rg.ell()
+    need, need_counts = exch.plan_flood_exchange(ell_idx, ell_mask, 4)
+    assert need.shape == (16, 4) and need_counts.shape == (4, 4)
+    # Own-shard rows never ride the wire.
+    for d in range(4):
+        assert not need[d * 4:(d + 1) * 4, d].any()
+    # Contiguous-arc partition of a ring: each shard needs exactly the
+    # two boundary rows of its neighbors.
+    assert need.sum() == 8
+    assert np.array_equal(need_counts, need.reshape(4, 4, 4).sum(axis=1))
+
+
+# ---------------------------------------------------------------------------
+# The headline invariant: delta bitwise-identical to dense
+# ---------------------------------------------------------------------------
+
+def _family_graph(family, n, seed):
+    if family == "erdos_renyi":
+        return pg.erdos_renyi(n, 0.08, seed=seed)
+    if family == "barabasi_albert":
+        return pg.barabasi_albert(n, m=2, seed=seed)
+    if family == "watts_strogatz":
+        return pg.watts_strogatz(n, k=4, beta=0.1, seed=seed)
+    return pg.ring_graph(n)
+
+
+@pytest.mark.parametrize(
+    "family", ["erdos_renyi", "barabasi_albert", "watts_strogatz", "ring"]
+)
+def test_delta_parity_topology_families(family):
+    g = _family_graph(family, 72, 7)
+    sched = pg.uniform_renewal_schedule(72, sim_time=4.0, tick_dt=0.01,
+                                        seed=7)
+    dense = run_sharded_sim(g, sched, 400, _cpu_mesh(4, 2), chunk_size=32,
+                            ring_mode="sharded")
+    delta = run_sharded_sim(g, sched, 400, _cpu_mesh(4, 2), chunk_size=32,
+                            exchange="delta")
+    assert delta.equal_counts(dense), family
+    assert np.array_equal(delta.received, dense.received)
+    ex = delta.extra["exchange"]
+    assert ex["mode"] == "delta" and ex["capacity"] >= 8
+    assert ex["exchange_ticks"] > 0
+    assert ex["achieved_delta_words_per_tick"] > 0
+
+
+def test_delta_parity_multi_delay_churn_loss():
+    """The full-hazard cell: per-edge delays (L>1 ring slots), link loss,
+    and churn — delta must still match dense AND the event oracle."""
+    g = pg.erdos_renyi(64, 0.1, seed=9)
+    d = lognormal_delays(g, mean_ticks=2.0, sigma=0.6, max_ticks=4, seed=9)
+    sched = pg.uniform_renewal_schedule(64, sim_time=5.0, tick_dt=0.01,
+                                        seed=9)
+    loss = pg.LinkLossModel(0.25, seed=4)
+    churn = pg.random_churn(64, 500, outage_prob=0.3, mean_down_ticks=40,
+                            seed=5)
+    ev = run_event_sim(g, sched, 500, ell_delays=d, loss=loss, churn=churn)
+    kw = dict(ell_delays=d, chunk_size=32, loss=loss, churn=churn)
+    dense = run_sharded_sim(g, sched, 500, _cpu_mesh(4, 2),
+                            ring_mode="sharded", **kw)
+    delta = run_sharded_sim(g, sched, 500, _cpu_mesh(4, 2),
+                            exchange="delta", **kw)
+    assert dense.equal_counts(ev)
+    assert delta.equal_counts(ev)
+    assert delta.extra["ring"]["delay_splits"] > 1
+
+
+@pytest.mark.parametrize("shards", [(8, 1), (2, 4)])
+def test_delta_parity_mesh_shapes(shards):
+    g = pg.barabasi_albert(96, m=3, seed=11)
+    sched = pg.uniform_renewal_schedule(96, sim_time=3.0, tick_dt=0.01,
+                                        seed=11)
+    ev = run_event_sim(g, sched, 300)
+    delta = run_sharded_sim(g, sched, 300, _cpu_mesh(*shards),
+                            chunk_size=32, exchange="delta")
+    assert delta.equal_counts(ev)
+
+
+def test_delta_parity_flood_coverage_and_fallback():
+    """Flood-coverage runner under delta, on a graph dense enough that
+    the fixed-capacity buffers overflow: the dense fallback must fire
+    (the counters say so) and coverage must stay bitwise-identical."""
+    from p2p_gossip_tpu.engine.sync import run_flood_coverage
+
+    g = pg.erdos_renyi(48, 0.3, seed=3)  # dense: cut >> capacity floor
+    origins = [0, 7, 23, 41]
+    st_s, cov_s = run_flood_coverage(g, origins, 40)
+    st_d, cov_d = run_sharded_flood_coverage(
+        g, origins, 40, _cpu_mesh(4, 2), chunk_size=64, exchange="delta"
+    )
+    assert np.array_equal(cov_s, cov_d)
+    assert np.array_equal(st_s.received, st_d.received)
+    ex = st_d.extra["exchange"]
+    assert ex["overflow_write_ticks"] > 0, ex
+    assert ex["dense_fallback_reads"] > 0, ex
+
+
+def test_delta_parity_partnered_runner():
+    """Anti-entropy (pushpull) sharded runner: the history-mirror delta
+    path must reproduce the dense all_gather reads bitwise, with and
+    without loss."""
+    from p2p_gossip_tpu.models.protocols import run_pushpull_sim
+
+    g = pg.erdos_renyi(60, 0.1, seed=13)
+    sched = pg.uniform_renewal_schedule(60, sim_time=3.0, tick_dt=0.01,
+                                        seed=13)
+    for loss in (None, pg.LinkLossModel(0.2, seed=6)):
+        solo, _ = run_pushpull_sim(g, sched, 300, seed=2, loss=loss)
+        dense = run_sharded_partnered_sim(
+            g, sched, 300, _cpu_mesh(2, 2), protocol="pushpull", seed=2,
+            chunk_size=32, loss=loss,
+        )
+        delta = run_sharded_partnered_sim(
+            g, sched, 300, _cpu_mesh(2, 2), protocol="pushpull", seed=2,
+            chunk_size=32, loss=loss, exchange="delta",
+        )
+        assert dense.equal_counts(solo), loss
+        assert delta.equal_counts(solo), loss
+        assert delta.extra["exchange"]["mode"] == "delta"
+
+
+def test_delta_digest_streams_match_dense():
+    """Flight-recorder view of the same invariant: the per-tick state
+    digest streams of a dense and a delta run must be identical — the
+    contract scripts/divergence.py --pair sync-delta bisects against."""
+    from p2p_gossip_tpu import telemetry
+    from p2p_gossip_tpu.telemetry import compare
+
+    g = pg.erdos_renyi(48, 0.12, seed=15)
+    sched = pg.uniform_renewal_schedule(48, sim_time=4.0, tick_dt=0.01,
+                                        seed=15)
+    assert sched.num_shares > 0  # a vacuous run would pass trivially
+
+    def capture(tmp, **kw):
+        telemetry.configure(str(tmp), rings=True)
+        try:
+            run_sharded_sim(g, sched, 400, _cpu_mesh(2, 2), chunk_size=32,
+                            **kw)
+        finally:
+            telemetry.close()
+        events = list(telemetry.events())
+        telemetry.reset()
+        return compare.select_stream(
+            compare.digest_streams(events), kernel="engine_sharded", shard=0
+        )
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        dense = capture(td + "/dense.jsonl", ring_mode="sharded")
+        delta = capture(td + "/delta.jsonl", exchange="delta")
+    assert dense and dense == delta
+    div = compare.first_divergence(dense, delta)
+    assert not div.diverged and div.compared == len(dense)
+
+
+def test_partitioned_delta_shrinks_achieved_traffic():
+    """End-to-end perf claim at test scale: partition-relabeling a
+    small-world graph, then running delta exchange, must achieve fewer
+    wire words/tick than the dense model — steady-state ticks fit the
+    capacity (at most the initial flood burst overflows) — while staying
+    bitwise-exact."""
+    g = pg.watts_strogatz(96, k=4, beta=0.05, seed=17)
+    labels = partition_labels(g, 4)
+    rg, inv = relabel_graph(g, partition_order(labels))
+    sched = pg.uniform_renewal_schedule(96, sim_time=3.0, tick_dt=0.01,
+                                        seed=17)
+    r_sched = pg.Schedule(
+        sched.n_nodes, inv[sched.origins].astype(np.int32),
+        sched.gen_ticks.copy(),
+    )
+    dense = run_sharded_sim(rg, r_sched, 300, _cpu_mesh(4, 2),
+                            chunk_size=32, ring_mode="sharded")
+    delta = run_sharded_sim(rg, r_sched, 300, _cpu_mesh(4, 2),
+                            chunk_size=32, exchange="delta")
+    assert delta.equal_counts(dense)
+    ex = delta.extra["exchange"]
+    assert ex["overflow_write_ticks"] <= 2, ex
+    assert (ex["achieved_delta_words_per_tick"]
+            < ex["modeled_dense_words_per_tick"])

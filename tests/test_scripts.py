@@ -260,7 +260,7 @@ def test_mesh_rehearsal_partnered_protocol():
     for row in rows:
         assert row["parity_vs_single_device"] is True
         assert row["coverage_final_min"] == 400
-    assert "ring layouts bitwise-equal" in r.stderr
+    assert "mesh legs bitwise-equal" in r.stderr
 
 
 def test_protocol_compare_cpu_flag():

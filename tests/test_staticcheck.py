@@ -35,7 +35,11 @@ def test_registry_covers_every_engine_layer():
         "models.protocols._run_pushpull_replicas",
         "models.protocols._run_pushk_replicas",
         "parallel.engine_sharded.flood_runner",
+        "parallel.engine_sharded.flood_runner[delta]",
         "parallel.protocols_sharded.pushpull_runner",
+        "parallel.protocols_sharded.pushpull_runner[delta]",
+        "parallel.exchange.compress_deltas[delta]",
+        "parallel.exchange.scatter_deltas[delta]",
         "ops.ell.propagate",
         "ops.segment.scatter_or",
         "ops.bitmask.coverage_per_slot",
@@ -262,6 +266,14 @@ def test_prng_fixture_flagged():
 
     report = prng_fixture()
     assert not report["ok"]
+
+
+def test_exchange_fixture_flagged():
+    from p2p_gossip_tpu.staticcheck.fixtures import exchange_fixture
+
+    report = exchange_fixture()
+    assert not report["ok"]
+    assert {"integer-only"} <= {v["rule"] for v in report["violations"]}
 
 
 # ---------------------------------------------------------------------------

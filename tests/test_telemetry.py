@@ -444,4 +444,5 @@ def test_ring_signature_shape_is_stable():
     """The zero-cost checker keys on the ring's (cap, NUM_METRICS)
     uint32 signature; a column added without updating the checker (and
     the schema) must fail loudly here."""
-    assert schema.NUM_METRICS == len(schema.METRIC_COLUMNS) == 6
+    assert schema.NUM_METRICS == len(schema.METRIC_COLUMNS) == 7
+    assert schema.METRIC_COLUMNS[-1] == "exchange_words"
