@@ -438,6 +438,46 @@ def main() -> None:
         except Exception as e:
             log(f"exchange report: did not complete ({type(e).__name__})")
 
+    # Campaigns x shards (batch/campaign_sharded.py): R replicas of the
+    # node-sharded flood as ONE compiled program on a factorized
+    # (replicas, nodes) mesh. The bench process can't re-fan its own
+    # backend out to 8 virtual devices after init, so the measurement
+    # rides a CPU subprocess of scripts/mesh_rehearsal.py's --replicas
+    # leg — which also bitwise-checks every replica against its solo
+    # node-sharded run before timing. The row is platform-labeled inside
+    # ("platform": "cpu"); chip-scale numbers are the battery's
+    # campaign_sharded stage. None on smoke or when the leg could not
+    # run.
+    campaign_sharded = None
+    if not smoke:
+        cs_args = [sys.executable, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts",
+            "mesh_rehearsal.py"), "--nodes", "4000", "--prob", "0.003",
+            "--shares", "32", "--horizon", "32", "--devices", "8",
+            "--replicas", "4", "--replica-shards", "2"]
+        try:
+            csr = subprocess.run(
+                cs_args, capture_output=True, text=True, timeout=600,
+                env=sc_env,
+            )
+            if csr.returncode == 0:
+                campaign_sharded = json.loads(
+                    csr.stdout.strip().splitlines()[-1]
+                )
+                log(
+                    "campaign-sharded leg: "
+                    f"{campaign_sharded['bitwise_equal_replicas']}/"
+                    f"{campaign_sharded['replicas']} replicas bitwise, "
+                    f"warm x{campaign_sharded['speedup_warm_per_replica']}"
+                    " vs sequential solo loop (cpu subprocess)"
+                )
+            else:
+                log(f"campaign-sharded leg: FAIL (rc={csr.returncode}) "
+                    f"{csr.stderr[-400:]}")
+        except Exception as e:
+            log(f"campaign-sharded leg: did not complete "
+                f"({type(e).__name__})")
+
     row = {
         "metric": (
             f"node-updates/sec ({n // 1000}K-node p={p:g} gossip "
@@ -482,6 +522,11 @@ def main() -> None:
         # benchmark topology family (platform-labeled, see above); None
         # on smoke or when it could not run.
         "exchange": exchange,
+        # One factorized (replicas, nodes)-mesh campaign row from the
+        # rehearsal script's --replicas leg (platform-labeled "cpu",
+        # bitwise-checked per replica); None on smoke or when it could
+        # not run.
+        "campaign_sharded": campaign_sharded,
     }
     row["campaign"] = {
         "metric": (

@@ -11,6 +11,10 @@ makes inference stacks fast, applied to simulation:
 - ``batch.campaign`` — replica-set builders and the vmapped engines
   (coverage campaigns with per-replica coverage-tick capture; gossip
   campaigns chunked over the share axis);
+- ``batch.campaign_sharded`` — campaigns x shards: R replicas of a
+  NODE-SHARDED graph in one program over a factorized (replicas, nodes)
+  mesh (``parallel.mesh.make_mesh(replicas=…)``) — the batch lever for
+  graphs too big for one chip;
 - ``batch.stats``    — ensemble reduction: time-to-coverage percentiles
   (p50/p95/p99), counter confidence intervals, redundancy distributions;
 - ``batch.sweep``    — parameter-grid sweeps over {protocol, p, lossProb,
@@ -30,6 +34,10 @@ from p2p_gossip_tpu.batch.campaign import (
     run_coverage_campaign,
     run_gossip_campaign,
 )
+from p2p_gossip_tpu.batch.campaign_sharded import (
+    run_sharded_campaign,
+    run_sharded_protocol_campaign,
+)
 from p2p_gossip_tpu.batch.stats import ensemble_summary, format_campaign_report
 
 __all__ = [
@@ -39,6 +47,8 @@ __all__ = [
     "gossip_replicas",
     "run_coverage_campaign",
     "run_gossip_campaign",
+    "run_sharded_campaign",
+    "run_sharded_protocol_campaign",
     "ensemble_summary",
     "format_campaign_report",
 ]

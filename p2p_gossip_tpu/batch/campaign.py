@@ -237,6 +237,10 @@ class CampaignResult:
     wall_s: float
     batch_size: int
     coverage: np.ndarray | None = None
+    #: Run-level reports that don't fit the per-replica arrays (the
+    #: sharded campaign's resolved ring / exchange modes, achieved delta
+    #: counters, mesh shape) — mirrors ``NodeStats.extra``.
+    extra: dict = dataclasses.field(default_factory=dict)
 
     @property
     def num_replicas(self) -> int:

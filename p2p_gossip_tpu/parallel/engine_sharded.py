@@ -444,11 +444,36 @@ def build_sharded_runner(
     telemetry_on: bool = False,
     exchange_mode: str = "dense",
     delta_capacity: int = 0,
+    replica_axis: str | None = None,
+    local_replicas: int = 1,
+    per_replica_loss: bool = False,
 ):
     """Compile the per-pass runner: each shares-shard processes its own
     ``chunk_size`` shares over the row-sharded graph, from the chunk's first
     generation tick to quiescence. Memoized so repeated calls with the same
     mesh/shapes reuse the jitted executable.
+
+    ``replica_axis`` switches the runner to CAMPAIGN mode over a
+    factorized ``(replica_axis, nodes)`` mesh (mesh.make_mesh(replicas=…)):
+    the first mesh axis carries seed-ensemble replicas instead of share
+    shards, and the SAME tick step is ``jax.vmap``ed over each replica
+    shard's ``local_replicas`` batch inside ONE shared while_loop (vmap of
+    the whole solo loop would trigger JAX's batched-while transform —
+    per-element selects on every carried array, the ~4x cost
+    batch/campaign.py measured). Per-replica operands grow a leading
+    replica dim: origins/gen_ticks (R, chunk) sharded over the replica
+    axis, churn intervals (R, n_padded, K), and — with
+    ``per_replica_loss`` — one traced uint32 loss seed per replica
+    appended after ``snap_ticks`` (the static ``loss`` pair is then
+    (threshold, None); the traced seed feeds the same erasure coin, so a
+    solo run with that static seed matches bitwise). Outputs stay
+    per-replica — no counter psum over the first axis — giving global
+    (R, n_padded) counters, (R, horizon, cov_slots) coverage, per-replica
+    telemetry/digest rings, and (R, 8) delta counters. The loop runs to
+    the SLOWEST replica's quiescence; a replica past its own has an
+    all-zero frontier, so every extra tick is an exact identity — replica
+    r is bitwise-identical to its solo sharded run. Second return value
+    is the per-replica pass width (``chunk_size``).
 
     The first runner argument is the flat ``ell_args`` tuple staged by
     `_stage_ell_args` for (``uniform_delay``, ``delay_values``,
@@ -488,7 +513,23 @@ def build_sharded_runner(
     counter row [used_entries_lo, used_entries_hi, overflow_write_ticks,
     dense_fallback_reads, exchange_ticks, 0, 0, 0] for achieved-traffic
     accounting (host side: `stats.extra['exchange']`)."""
-    n_share_shards = mesh.shape[SHARES_AXIS]
+    campaign = replica_axis is not None
+    if campaign:
+        if local_replicas < 1:
+            raise ValueError(
+                f"local_replicas must be >= 1, got {local_replicas}"
+            )
+        # Campaign meshes carry replicas on axis 0, not share shards: the
+        # whole chunk rides one share pass per replica.
+        n_share_shards = 1
+    else:
+        n_share_shards = mesh.shape[SHARES_AXIS]
+    if per_replica_loss and (not campaign or loss is None):
+        raise ValueError(
+            "per_replica_loss requires replica_axis and a loss model"
+        )
+    axis0 = replica_axis if campaign else SHARES_AXIS
+    rb = local_replicas if campaign else 1
     n_node_shards = mesh.shape[NODES_AXIS]
     n_loc = n_padded // n_node_shards
     w = bitmask.num_words(chunk_size)
@@ -519,21 +560,29 @@ def build_sharded_runner(
     def pass_fn(
         ell_args, degree, churn_start, churn_end,
         origins, gen_ticks, t_start, last_gen, snap_ticks,
-        *delta_args,
+        *extra_args,
     ):
         # Local shapes: ell_args arrays (n_loc, cols); churn_* (n_loc, K)
         # downtime intervals ((n_loc, 1) zeros when churn is off — the
         # compare is vacuously up); origins/gen_ticks (chunk_size,);
         # t_start/last_gen scalars (min/max over ALL slices, so loop trip
         # counts agree across devices); snap_ticks (num_snaps,) replicated.
+        # Campaign mode prepends a local replica dim rb to churn_*,
+        # origins and gen_ticks, and appends the per-replica loss-seed
+        # vector (rb,) before the delta operand when per_replica_loss.
+        if campaign and per_replica_loss:
+            lseeds = extra_args[0]
+            delta_args = extra_args[1:]
+        else:
+            lseeds = None
+            delta_args = extra_args
         row_offset = lax.axis_index(NODES_AXIS).astype(jnp.int32) * n_loc
         slots = jnp.arange(chunk_size, dtype=jnp.int32)
         # (Loss-coin dst ids are built per bucket inside arrivals_for:
         # row_offset + the bucket's local rows — global ids, so every
         # mesh shape agrees with the single-device engines.)
 
-        state = (
-            t_start,
+        rstate = (
             jnp.zeros((n_loc, w), dtype=jnp.uint32),              # seen (local)
             # History ring: global rows (replicated) or local rows (sharded).
             jnp.zeros((ring_size, hist_rows, w), dtype=jnp.uint32),
@@ -551,14 +600,15 @@ def build_sharded_runner(
             ),                                                    # coverage
         )
         if tel:
-            state = state + (tel_rings.init(horizon),)            # metrics
-        dig_i = 8 + (1 if tel else 0)
+            rstate = rstate + (tel_rings.init(horizon),)          # metrics
+        tel_i = 7
+        dig_i = 7 + (1 if tel else 0)
         if dig:
-            state = state + (tel_digest.init(horizon),)           # digests
-        ex_i = 8 + (1 if tel else 0) + (1 if dig else 0)
+            rstate = rstate + (tel_digest.init(horizon),)         # digests
+        ex_i = 7 + (1 if tel else 0) + (1 if dig else 0)
         if delta:
             need = delta_args[0]  # (n_loc, n_shards) cut membership
-            state = state + (
+            rstate = rstate + (
                 # Received-delta rings, slot-aligned with hist: axis 1 is
                 # the SOURCE shard post all_to_all. idx -1 = empty.
                 jnp.full(
@@ -576,15 +626,25 @@ def build_sharded_runner(
                 #  exchange_ticks, 0, 0, 0]
                 jnp.zeros((8,), dtype=jnp.uint32),
             )
+        if campaign:
+            # One state copy per local replica: the tick step is vmapped
+            # over this leading rb axis inside the shared while_loop.
+            rstate = jax.tree_util.tree_map(
+                lambda a: jnp.broadcast_to(a, (rb,) + a.shape), rstate
+            )
+        state = (t_start,) + rstate
 
         def cond(state):
-            t, _, hist = state[0], state[1], state[2]
+            t, hist = state[0], state[2]
             # Local ring rows are a subset (sharded) or a replica
             # (replicated) of the global frontier state; the mesh-wide
-            # OR-reduce makes the predicate uniform either way.
+            # OR-reduce makes the predicate uniform either way. In
+            # campaign mode the loop runs until the SLOWEST replica on
+            # the mesh quiesces (extra ticks are exact identities for
+            # the already-quiet replicas, see build docstring).
             in_flight = jnp.any(hist != 0)
             in_flight = lax.psum(
-                in_flight.astype(jnp.int32), (SHARES_AXIS, NODES_AXIS)
+                in_flight.astype(jnp.int32), (axis0, NODES_AXIS)
             ) > 0
             return (t < horizon) & (in_flight | (t <= last_gen))
 
@@ -620,7 +680,7 @@ def build_sharded_runner(
             return lax.cond(dflag_ring[slot], dense_read, delta_read,
                             operand=None)
 
-        def arrivals_for(hist, dstate, t, loss_cfg=loss):
+        def arrivals_for(hist, dstate, t, loss_cfg=loss, lseed=None):
             # One gather group per delay value (one group total under a
             # uniform delay); read_slice resolves local vs all_gathered
             # per ring layout. Within a group, the degree buckets
@@ -662,6 +722,7 @@ def build_sharded_runner(
                         dst_ids=loss_dst_ids(
                             jnp.arange(n_loc, dtype=jnp.int32)
                         ),
+                        loss_seed=lseed,
                     )
                     continue
                 cat_rows, cat_parts = [], []
@@ -675,6 +736,7 @@ def build_sharded_runner(
                         block=max(1, min(block, idx_b.shape[1])),
                         loss=loss_cfg,
                         dst_ids=loss_dst_ids(rows_b),
+                        loss_seed=lseed,
                     )
                     cat_rows.append(rows_b)
                     cat_parts.append(part)
@@ -686,10 +748,15 @@ def build_sharded_runner(
                 acc = acc | grp
             return acc
 
-        def body(state):
-            t, seen, hist, received, sent, snaps, cov_run, cov_hist = state[:8]
+        def tick(rstate, origins_r, gen_ticks_r, churn_start_r, churn_end_r,
+                 lseed, t):
+            # ONE replica's tick over its node shard — the solo body
+            # verbatim, minus the tick counter (carried outside so the
+            # campaign vmap shares it). All collectives inside address
+            # NODES_AXIS only, so the vmap batches them per replica.
+            seen, hist, received, sent, snaps, cov_run, cov_hist = rstate[:7]
             if delta:
-                didx_ring, dval_ring, dflag_ring, ectr = state[ex_i:ex_i + 4]
+                didx_ring, dval_ring, dflag_ring, ectr = rstate[ex_i:ex_i + 4]
                 dstate = (didx_ring, dval_ring, dflag_ring)
                 # Dense fallbacks this tick: one per delay group whose
                 # read slot carries the (mesh-uniform) overflow flag.
@@ -707,7 +774,7 @@ def build_sharded_runner(
                 snaps = jnp.where(
                     (snap_ticks == t)[:, None], received[None, :], snaps
                 )
-            arrivals = arrivals_for(hist, dstate, t)
+            arrivals = arrivals_for(hist, dstate, t, lseed=lseed)
             if tel:
                 received_in = received
                 arrivals_raw = arrivals  # post-loss, pre-churn wire view
@@ -715,14 +782,14 @@ def build_sharded_runner(
                     arrivals_for(hist, dstate, t, None)
                     if loss is not None else None
                 )
-            up = up_mask_jnp(churn_start, churn_end, t)
+            up = up_mask_jnp(churn_start_r, churn_end_r, t)
             arrivals = jnp.where(up[:, None], arrivals, jnp.uint32(0))
-            local_rows = origins - row_offset
+            local_rows = origins_r - row_offset
             # Negative indices wrap under .at[] before mode="drop" applies,
             # so shares owned by other row shards must be masked explicitly.
             in_shard = (local_rows >= 0) & (local_rows < n_loc)
             gen_active = (
-                (gen_ticks == t)
+                (gen_ticks_r == t)
                 & in_shard
                 & up[jnp.clip(local_rows, 0, n_loc - 1)]
             )
@@ -806,7 +873,7 @@ def build_sharded_runner(
                 cov_hist = lax.dynamic_update_slice(
                     cov_hist, cov_run[None], (t, 0)
                 )
-            out = (t + 1, seen, hist, received, sent, snaps, cov_run, cov_hist)
+            out = (seen, hist, received, sent, snaps, cov_run, cov_hist)
             if tel:
                 # Per-chip state-slice exchange words received this tick
                 # (ICI traffic model, see exchange.py): the NODES psum
@@ -833,7 +900,7 @@ def build_sharded_runner(
                     ),
                     NODES_AXIS,
                 )
-                out = out + (tel_rings.write(state[8], t, met_row),)
+                out = out + (tel_rings.write(rstate[tel_i], t, met_row),)
             if dig:
                 # Global node ids make the salts mesh-shape-invariant;
                 # the node-pad rows are all-zero and the sparse fold
@@ -843,37 +910,81 @@ def build_sharded_runner(
                     node_ids=row_offset + jnp.arange(n_loc, dtype=jnp.int32),
                     axis_name=NODES_AXIS,
                 )
-                out = out + (tel_digest.write(state[dig_i], t, dval),)
+                out = out + (tel_digest.write(rstate[dig_i], t, dval),)
             if delta:
                 out = out + (didx_ring, dval_ring, dflag_ring, ectr)
             return out
 
+        if campaign:
+            def body(state):
+                t = state[0]
+                if per_replica_loss:
+                    new = jax.vmap(
+                        lambda rs, o, g, cs, ce, ls:
+                            tick(rs, o, g, cs, ce, ls, t)
+                    )(state[1:], origins, gen_ticks,
+                      churn_start, churn_end, lseeds)
+                else:
+                    new = jax.vmap(
+                        lambda rs, o, g, cs, ce:
+                            tick(rs, o, g, cs, ce, None, t)
+                    )(state[1:], origins, gen_ticks, churn_start, churn_end)
+                return (t + 1,) + new
+        else:
+            def body(state):
+                return (state[0] + 1,) + tick(
+                    state[1:], origins, gen_ticks, churn_start, churn_end,
+                    None, state[0],
+                )
+
         loop_out = lax.while_loop(cond, body, state)
-        t, seen, _, received, sent, snaps, cov_run, cov_hist = loop_out[:8]
+        t = loop_out[0]
+        received, sent, snaps = loop_out[3], loop_out[4], loop_out[5]
+        cov_run, cov_hist = loop_out[6], loop_out[7]
         if record_coverage:
             # Rows past quiescence hold the (monotone, now constant) final
             # coverage — same convention as the sync engine.
             ticks = jnp.arange(horizon, dtype=jnp.int32)[:, None]
-            cov_hist = jnp.where(ticks >= t, cov_run[None, :], cov_hist)
+            if campaign:
+                cov_hist = jnp.where(
+                    ticks[None] >= t, cov_run[:, None, :], cov_hist
+                )
+            else:
+                cov_hist = jnp.where(ticks >= t, cov_run[None, :], cov_hist)
         if num_snaps:
             # Boundaries at/after quiescence see the (unchanging) final
             # counts — same convention as the sync engine.
-            snaps = jnp.where((snap_ticks >= t)[:, None], received[None, :], snaps)
-        # Fold the independent share slices: counters add across SHARES_AXIS.
-        received = lax.psum(received, SHARES_AXIS)
-        sent = lax.psum(sent, SHARES_AXIS)
-        snaps = lax.psum(snaps, SHARES_AXIS)
+            if campaign:
+                snaps = jnp.where(
+                    (snap_ticks >= t)[None, :, None],
+                    received[:, None, :], snaps,
+                )
+            else:
+                snaps = jnp.where(
+                    (snap_ticks >= t)[:, None], received[None, :], snaps
+                )
+        if not campaign:
+            # Fold the independent share slices: counters add across
+            # SHARES_AXIS. (Campaign mode skips this: each replica's
+            # node-shard counters already cover its whole chunk.)
+            received = lax.psum(received, SHARES_AXIS)
+            sent = lax.psum(sent, SHARES_AXIS)
+            snaps = lax.psum(snaps, SHARES_AXIS)
         outs = (received, sent, snaps, cov_hist)
         if tel:
             # Stack per share-shard: each shard's ring is its chunk's
-            # telemetry (the host emits one event per shard).
-            outs = outs + (loop_out[8][None],)
+            # telemetry (the host emits one event per shard). Campaign
+            # rings already carry the leading replica axis.
+            ring_out = loop_out[1 + tel_i]
+            outs = outs + ((ring_out if campaign else ring_out[None]),)
         if dig:
-            outs = outs + (loop_out[dig_i][None],)
+            dg_out = loop_out[1 + dig_i]
+            outs = outs + ((dg_out if campaign else dg_out[None]),)
         if delta:
             # Achieved-exchange counters, stacked per share-shard like
             # the telemetry ring (uniform across node shards).
-            outs = outs + (loop_out[ex_i + 3][None],)
+            ec_out = loop_out[1 + ex_i + 3]
+            outs = outs + ((ec_out if campaign else ec_out[None]),)
         return outs
 
     # Per bucket triple: rows (S, R) + idx/mask (S, R, C), all with the
@@ -889,10 +1000,40 @@ def build_sharded_runner(
                 P(NODES_AXIS, None), P(NODES_AXIS, None, None),
                 P(NODES_AXIS, None, None),
             ) * bc
-    mapped = shard_map(
-        pass_fn,
-        mesh=mesh,
-        in_specs=(
+    if campaign:
+        # Per-replica operands: (R, …) over the replica axis; churn also
+        # sharded over nodes on axis 1. Outputs keep the replica axis —
+        # no share fold, each replica's counters are already complete.
+        sched_spec = P(replica_axis, None)
+        in_specs = (
+            ell_specs,            # ell_args (replicated over replicas)
+            P(NODES_AXIS),        # degree
+            P(replica_axis, NODES_AXIS, None),  # churn_start (R, n_pad, K)
+            P(replica_axis, NODES_AXIS, None),  # churn_end
+            sched_spec,           # origins (R, chunk)
+            sched_spec,           # gen_ticks (R, chunk)
+            P(),                  # t_start
+            P(),                  # last_gen
+            P(),                  # snap_ticks
+        )
+        if per_replica_loss:
+            in_specs = in_specs + (P(replica_axis),)  # loss seeds (R,)
+        if delta:
+            in_specs = in_specs + (P(NODES_AXIS, None),)  # cut membership
+        out_specs: tuple = (
+            P(replica_axis, NODES_AXIS),        # received (R, n_padded)
+            P(replica_axis, NODES_AXIS),        # sent
+            P(replica_axis, None, NODES_AXIS),  # snapshots
+            P(replica_axis, None, None),        # coverage (R, horizon, slots)
+        )
+        if tel:
+            out_specs = out_specs + (P(replica_axis, None, None),)
+        if dig:
+            out_specs = out_specs + (P(replica_axis, None),)
+        if delta:
+            out_specs = out_specs + (P(replica_axis, None),)
+    else:
+        in_specs = (
             ell_specs,            # ell_args (bucketed, see _stage_ell_args)
             P(NODES_AXIS),        # degree
             P(NODES_AXIS, None),  # churn_start
@@ -902,18 +1043,25 @@ def build_sharded_runner(
             P(),                  # t_start
             P(),                  # last_gen
             P(),                  # snap_ticks
-        )
-        + ((P(NODES_AXIS, None),) if delta else ()),  # cut membership
-        out_specs=(
+        ) + ((P(NODES_AXIS, None),) if delta else ())  # cut membership
+        out_specs = (
             P(NODES_AXIS), P(NODES_AXIS), P(None, NODES_AXIS),
             P(None, SHARES_AXIS),
+        ) + (
+            ((P(SHARES_AXIS, None, None),) if tel else ())
+            + ((P(SHARES_AXIS, None),) if dig else ())
+            + ((P(SHARES_AXIS, None),) if delta else ())  # exchange ctrs
         )
-        + ((P(SHARES_AXIS, None, None),) if tel else ())
-        + ((P(SHARES_AXIS, None),) if dig else ())
-        + ((P(SHARES_AXIS, None),) if delta else ()),  # exchange counters
+    mapped = shard_map(
+        pass_fn,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
         check_vma=False,
     )
-    return jax.jit(mapped), n_share_shards * chunk_size
+    return jax.jit(mapped), (
+        chunk_size if campaign else n_share_shards * chunk_size
+    )
 
 
 # --- staticcheck audit spec (p2p_gossip_tpu/staticcheck/) -----------------
@@ -929,19 +1077,41 @@ def _audit_mesh():
     return make_mesh(shards, shards, devices=devices[: shards * shards]), shards
 
 
+def _audit_campaign_mesh():
+    """Smallest factorized (replicas, nodes) mesh the audit can stage:
+    (2 replicas x 2 nodes) when four devices exist, else (1 x 1)."""
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    if len(devices) >= 4:
+        return make_mesh(2, devices=devices[:4], replicas=2)
+    return make_mesh(1, devices=devices[:1], replicas=1)
+
+
 def _audit_spec_flood_runner(
-    telemetry_on: bool = False, exchange: str = "dense"
+    telemetry_on: bool = False, exchange: str = "dense",
+    campaign: bool = False,
 ):
     """Stage + compile-build the sharded flood runner on tiny shapes and
     hand the auditor the exact mapped callable the production driver
     runs (shard_map + jit), uniform delay, sharded ring; ``exchange``
     "delta" audits the sparse frontier-delta path (both cond branches
-    trace, so the dense fallback is covered too)."""
+    trace, so the dense fallback is covered too). ``campaign`` audits
+    the replica-factorized mode (vmapped tick over the replica batch on
+    a (replicas, nodes) mesh) — the jit surface
+    batch/campaign_sharded.py dispatches."""
     from p2p_gossip_tpu.models.topology import erdos_renyi
     from p2p_gossip_tpu.staticcheck.registry import AuditSpec
     from p2p_gossip_tpu.telemetry.schema import NUM_METRICS
 
-    mesh, _ = _audit_mesh()
+    if campaign:
+        from p2p_gossip_tpu.parallel.mesh import REPLICAS_AXIS
+
+        mesh = _audit_campaign_mesh()
+        local_replicas = 2
+        r_batch = mesh.shape[REPLICAS_AXIS] * local_replicas
+    else:
+        mesh, _ = _audit_mesh()
     graph = erdos_renyi(16, 0.3, seed=0)
     chunk, horizon = 32, 16
     (ell_idx, ell_delay, ell_mask, degree, ring, uniform, n_padded, block,
@@ -960,10 +1130,19 @@ def _audit_spec_flood_runner(
         ring_mode=ring_mode, delay_values=delay_values,
         bucket_counts=bucket_counts, telemetry_on=telemetry_on,
         exchange_mode=exchange_mode, delta_capacity=capacity,
+        replica_axis=(REPLICAS_AXIS if campaign else None),
+        local_replicas=(local_replicas if campaign else 1),
     )
-    origins = np.zeros(pass_size, dtype=np.int32)
-    gen_ticks = np.full(pass_size, horizon, dtype=np.int32)
-    gen_ticks[:2] = 0
+    if campaign:
+        origins = np.zeros((r_batch, pass_size), dtype=np.int32)
+        gen_ticks = np.full((r_batch, pass_size), horizon, dtype=np.int32)
+        gen_ticks[:, :2] = 0
+        churn_start = np.zeros((r_batch, n_padded, 1), dtype=np.int32)
+        churn_end = churn_start.copy()
+    else:
+        origins = np.zeros(pass_size, dtype=np.int32)
+        gen_ticks = np.full(pass_size, horizon, dtype=np.int32)
+        gen_ticks[:2] = 0
     words: tuple = (bitmask.num_words(chunk),)
     if telemetry_on:
         # Stacked per-shard digest rings are (1, horizon) uint32 — the
@@ -998,6 +1177,14 @@ register_entry(
 register_entry(
     "parallel.engine_sharded.flood_runner[delta]",
     spec=lambda: _audit_spec_flood_runner(exchange="delta"),
+)
+register_entry(
+    "parallel.engine_sharded.flood_runner[campaign]",
+    spec=lambda: _audit_spec_flood_runner(campaign=True),
+)
+register_entry(
+    "parallel.engine_sharded.flood_runner[campaign-delta]",
+    spec=lambda: _audit_spec_flood_runner(exchange="delta", campaign=True),
 )
 
 

@@ -60,15 +60,77 @@ def shard_map(*args, **kwargs):
 
 NODES_AXIS = "nodes"
 SHARES_AXIS = "shares"
+REPLICAS_AXIS = "replicas"
+
+#: Default per-device HBM budget the automatic (replica, node) axis split
+#: sizes the node axis against (v5e chips carry 16 GB). Overridable per
+#: call (``hbm_bytes``) or process-wide via P2P_HBM_BUDGET_GB.
+DEFAULT_HBM_BYTES = 16 * 10**9
+
+
+def estimate_node_bytes(
+    n_padded: int, max_degree: int, words: int, ring_size: int = 2
+) -> int:
+    """Rough whole-graph device footprint of one sharded-engine replica:
+    the int32 ELL triple (idx/delay/mask at the padded column cap), the
+    seen bitmask, the sharded history ring, and the three counter rows.
+    Feed it to ``make_mesh(replicas="auto", node_bytes=...)`` — it only
+    has to land on the right power-of-two shard count, not be exact."""
+    return 4 * n_padded * (3 * max_degree + words * (1 + ring_size) + 3)
+
+
+def auto_axis_split(
+    n_devices: int,
+    node_bytes: int | None = None,
+    hbm_bytes: int | None = None,
+) -> tuple[int, int]:
+    """Choose the (replica_shards, node_shards) factorization of
+    ``n_devices``: the SMALLEST node-shard count whose per-device slice of
+    ``node_bytes`` fits the HBM budget, handing every remaining device to
+    the replica axis (replica parallelism is free; node sharding buys HBM
+    at the price of per-tick exchange traffic). ``node_bytes`` None means
+    "fits anywhere" — all devices go to replicas. Candidate counts are the
+    divisors of ``n_devices`` so the mesh always fills; if even the full
+    mesh can't fit the graph, the full mesh is returned (the caller's RSS
+    preflight owns that failure)."""
+    if n_devices < 1:
+        raise ValueError(f"need at least one device, got {n_devices}")
+    if hbm_bytes is None:
+        hbm_bytes = int(
+            float(os.environ.get("P2P_HBM_BUDGET_GB", 0)) * 1e9
+        ) or DEFAULT_HBM_BYTES
+    node_shards = 1
+    if node_bytes is not None:
+        for d in sorted(
+            d for d in range(1, n_devices + 1) if n_devices % d == 0
+        ):
+            node_shards = d
+            if node_bytes / d <= hbm_bytes:
+                break
+    return n_devices // node_shards, node_shards
 
 
 def make_mesh(
     n_node_shards: int | None = None,
     n_share_shards: int = 1,
     devices=None,
+    replicas: int | str | None = None,
+    node_bytes: int | None = None,
+    hbm_bytes: int | None = None,
 ) -> Mesh:
     """Build a (shares, nodes) mesh. Defaults to all devices on the nodes
-    axis (frontier exchange prefers the faster/denser axis)."""
+    axis (frontier exchange prefers the faster/denser axis).
+
+    ``replicas`` switches to the FACTORIZED 2-D ``(replicas, nodes)``
+    mesh the sharded campaign drivers (batch/campaign_sharded.py) run on:
+    seed-ensemble replicas ride the first axis, graph rows the second.
+    Pass an int for an explicit replica-shard count (node shards default
+    to the remaining devices), or ``"auto"`` to derive the split from the
+    graph footprint vs per-device HBM (``auto_axis_split``:
+    ``node_bytes`` is the estimated whole-graph device footprint — see
+    ``estimate_node_bytes`` — and ``hbm_bytes`` the per-device budget,
+    default $P2P_HBM_BUDGET_GB or 16 GB). An explicit ``n_node_shards``
+    overrides the automatic node-axis choice either way."""
     if devices is None:
         # Honor an explicitly configured default device or JAX_PLATFORMS
         # (experimental TPU plugins can register even when the user pinned
@@ -90,6 +152,34 @@ def make_mesh(
         else:
             devices = jax.devices()
     devices = list(devices)
+    if replicas is not None:
+        n_dev = len(devices)
+        if replicas == "auto":
+            replica_shards, auto_nodes = auto_axis_split(
+                n_dev, node_bytes=node_bytes, hbm_bytes=hbm_bytes
+            )
+            if n_node_shards is not None:  # explicit override wins
+                replica_shards = n_dev // n_node_shards
+            else:
+                n_node_shards = auto_nodes
+        else:
+            replica_shards = int(replicas)
+            if replica_shards < 1:
+                raise ValueError(
+                    f"replicas must be >= 1 or 'auto', got {replicas!r}"
+                )
+            if n_node_shards is None:
+                n_node_shards = n_dev // replica_shards
+        want = replica_shards * n_node_shards
+        if want < 1 or want > n_dev:
+            raise ValueError(
+                f"mesh {replica_shards}x{n_node_shards} (replicas x nodes) "
+                f"needs {want} devices, have {n_dev}"
+            )
+        dev_array = np.array(devices[:want]).reshape(
+            replica_shards, n_node_shards
+        )
+        return Mesh(dev_array, (REPLICAS_AXIS, NODES_AXIS))
     if n_node_shards is None:
         n_node_shards = len(devices) // n_share_shards
     want = n_node_shards * n_share_shards

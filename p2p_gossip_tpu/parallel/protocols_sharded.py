@@ -110,9 +110,26 @@ def build_partnered_runner(
     telemetry_on: bool = False,
     exchange_mode: str = "dense",
     delta_capacity: int = 0,
+    replica_axis: str | None = None,
+    local_replicas: int = 1,
+    per_replica_loss: bool = False,
 ):
     """Compile the per-pass runner for a random-partner protocol over the
     mesh. Memoized on mesh/shapes like engine_sharded.build_sharded_runner.
+
+    ``replica_axis`` switches to CAMPAIGN mode over a factorized
+    (replica, node) mesh, exactly like
+    engine_sharded.build_sharded_runner: the round step is vmapped over
+    each replica shard's ``local_replicas`` batch inside one shared
+    fori_loop. Per-replica operands grow a leading replica dim —
+    origins/gen_ticks (R, chunk), churn intervals (R, n_padded, K, still
+    replicated over nodes: partner up-checks need every node), the
+    protocol seed becomes an (R,) vector, and ``per_replica_loss``
+    appends an (R,) uint32 loss-seed vector (static ``loss`` then
+    (threshold, None); traced seeds feed the same coin, so solo runs
+    with the matching static seed are bitwise-identical). Outputs keep
+    the replica axis instead of the share-shard stack; second return
+    value is the per-replica pass width (``chunk_size``).
 
     Counters come back stacked per share-shard — (n_share_shards, n_padded)
     int32 received and uint32 sent lo/hi pairs — and the host folds them in
@@ -137,7 +154,20 @@ def build_partnered_runner(
         raise ValueError(f"fanout must be >= 1, got {fanout}")
     tel = tel_rings.active(telemetry_on)
     dig = tel_digest.active(telemetry_on)
-    n_share_shards = mesh.shape[SHARES_AXIS]
+    campaign = replica_axis is not None
+    if campaign:
+        if local_replicas < 1:
+            raise ValueError(
+                f"local_replicas must be >= 1, got {local_replicas}"
+            )
+        n_share_shards = 1
+    else:
+        n_share_shards = mesh.shape[SHARES_AXIS]
+    if per_replica_loss and (not campaign or loss is None):
+        raise ValueError(
+            "per_replica_loss requires replica_axis and a loss model"
+        )
+    rb = local_replicas if campaign else 1
     n_node_shards = mesh.shape[NODES_AXIS]
     n_loc = n_padded // n_node_shards
     w = bitmask.num_words(chunk_size)
@@ -166,11 +196,17 @@ def build_partnered_runner(
 
     def pass_fn(
         ell_idx, ell_delay, degree, churn_start, churn_end,
-        origins, gen_ticks, seed,
+        origins, gen_ticks, seed, *extra_args,
     ):
         # Local: ell_* (n_loc, dmax), degree (n_loc,), origins/gen_ticks
         # (chunk_size,). Replicated: churn_* (n_padded, K) — partner up
         # checks need every node's intervals — and the seed scalar.
+        # Campaign mode prepends a local replica dim rb to churn_*,
+        # origins, gen_ticks and the seed, and appends the per-replica
+        # loss-seed vector (rb,) when per_replica_loss.
+        lseeds = (
+            extra_args[0] if (campaign and per_replica_loss) else None
+        )
         row_offset = lax.axis_index(NODES_AXIS).astype(jnp.int32) * n_loc
         node_ids = row_offset + jnp.arange(n_loc, dtype=jnp.int32)
         slots = jnp.arange(chunk_size, dtype=jnp.int32)
@@ -223,21 +259,31 @@ def build_partnered_runner(
                 #  exchange_ticks, 0, 0, 0]
                 jnp.zeros((8,), dtype=jnp.uint32),
             )
+        if campaign:
+            # One state copy per local replica: the round step is
+            # vmapped over this leading rb axis inside the fori_loop.
+            state = jax.tree_util.tree_map(
+                lambda a: jnp.broadcast_to(a, (rb,) + a.shape), state
+            )
 
-        def body(t, state):
-            seen, hist, received, sent_lo, sent_hi, cov_hist = state[:6]
+        def tick(rstate, origins_r, gen_ticks_r, seed_r, lseed_r,
+                 churn_start_r, churn_end_r, t):
+            # ONE replica's round over its node shard — the solo body
+            # verbatim; all collectives address NODES_AXIS only, so the
+            # campaign vmap batches them per replica.
+            seen, hist, received, sent_lo, sent_hi, cov_hist = rstate[:6]
             if delta:
                 (mirrors, didx_ring, dval_ring, dflag_ring,
-                 ectr) = state[ex_i:ex_i + 5]
+                 ectr) = rstate[ex_i:ex_i + 5]
             t = jnp.int32(t)
             if anti:
-                kidx = pick_index_jnp(node_ids, t, 0, degree, seed)
+                kidx = pick_index_jnp(node_ids, t, 0, degree, seed_r)
                 partners = ell_idx[rows_l, kidx]          # (n_loc,) global
                 delay = ell_delay[rows_l, kidx]
             else:
                 picks = jnp.arange(k, dtype=jnp.int32)[None, :]
                 kidx = pick_index_jnp(
-                    node_ids[:, None], t, picks, degree[:, None], seed
+                    node_ids[:, None], t, picks, degree[:, None], seed_r
                 )
                 partners = ell_idx[rows_l[:, None], kidx]  # (n_loc, k)
                 delay = ell_delay[rows_l[:, None], kidx]
@@ -275,7 +321,7 @@ def build_partnered_runner(
                 else:
                     my_old = flat[slot * n_padded + node_ids[:, None]]  # (n_loc,k,W)
 
-            up = up_mask_jnp(churn_start, churn_end, t)   # (n_padded,)
+            up = up_mask_jnp(churn_start_r, churn_end_r, t)   # (n_padded,)
             self_ids = node_ids if anti else node_ids[:, None]
             attempted = (
                 up[self_ids] & up[partners]
@@ -283,7 +329,8 @@ def build_partnered_runner(
             )
             pull_ok = push_ok = attempted
             if loss is not None:
-                thr, lseed = loss
+                thr = loss[0]
+                lseed = loss[1] if lseed_r is None else lseed_r
                 push_ok = attempted & ~drop_mask_jnp(
                     self_ids, partners, t, thr, lseed
                 )
@@ -341,9 +388,9 @@ def build_partnered_runner(
 
             sent_lo, sent_hi = bitmask.add_u64(sent_lo, sent_hi, sent_add)
 
-            local_origin_rows = origins - row_offset
+            local_origin_rows = origins_r - row_offset
             in_shard = (local_origin_rows >= 0) & (local_origin_rows < n_loc)
-            gen_active = (gen_ticks == t) & in_shard & up[origins]
+            gen_active = (gen_ticks_r == t) & in_shard & up[origins_r]
             gen_bits = bitmask.slot_scatter(
                 n_loc, w, local_origin_rows, slots, gen_active
             )
@@ -494,7 +541,7 @@ def build_partnered_runner(
                     ),
                     NODES_AXIS,
                 )
-                out = out + (tel_rings.write(state[6], t, met_row),)
+                out = out + (tel_rings.write(rstate[6], t, met_row),)
             if dig:
                 # Global node ids keep the salts mesh-shape-invariant; the
                 # ELL-pad rows stay all-zero and the sparse fold skips
@@ -504,13 +551,41 @@ def build_partnered_runner(
                     node_ids=node_ids, axis_name=NODES_AXIS,
                     sent_hi=sent_hi,
                 )
-                out = out + (tel_digest.write(state[dig_i], t, dval),)
+                out = out + (tel_digest.write(rstate[dig_i], t, dval),)
             if delta:
                 out = out + (mirrors, didx_ring, dval_ring, dflag_ring, ectr)
             return out
 
+        if campaign:
+            def body(t, state):
+                if per_replica_loss:
+                    return jax.vmap(
+                        lambda rs, o, g, sd, ls, cs, ce:
+                            tick(rs, o, g, sd, ls, cs, ce, t)
+                    )(state, origins, gen_ticks, seed, lseeds,
+                      churn_start, churn_end)
+                return jax.vmap(
+                    lambda rs, o, g, sd, cs, ce:
+                        tick(rs, o, g, sd, None, cs, ce, t)
+                )(state, origins, gen_ticks, seed, churn_start, churn_end)
+        else:
+            def body(t, state):
+                return tick(state, origins, gen_ticks, seed, None,
+                            churn_start, churn_end, t)
+
         loop_out = lax.fori_loop(0, horizon, body, state)
-        seen, _, received, sent_lo, sent_hi, cov_hist = loop_out[:6]
+        received, sent_lo, sent_hi = loop_out[2], loop_out[3], loop_out[4]
+        cov_hist = loop_out[5]
+        if campaign:
+            # Campaign outputs already carry the leading replica axis.
+            out = (received, sent_lo, sent_hi, cov_hist)
+            if tel:
+                out = out + (loop_out[6],)
+            if dig:
+                out = out + (loop_out[dig_i],)
+            if delta:
+                out = out + (loop_out[ex_i + 4],)
+            return out
         # Stack per share-shard (host folds in int64; psum of u32 halves
         # would drop carries).
         out = (received[None], sent_lo[None], sent_hi[None], cov_hist[None])
@@ -523,10 +598,33 @@ def build_partnered_runner(
             out = out + (loop_out[ex_i + 4][None],)
         return out
 
-    mapped = shard_map(
-        pass_fn,
-        mesh=mesh,
-        in_specs=(
+    if campaign:
+        in_specs = (
+            P(NODES_AXIS, None),  # ell_idx
+            P(NODES_AXIS, None),  # ell_delay
+            P(NODES_AXIS),        # degree
+            # Churn is per replica but still replicated over nodes
+            # (partner up-checks need every node's intervals).
+            P(replica_axis, None, None),  # churn_start (R, n_padded, K)
+            P(replica_axis, None, None),  # churn_end
+            P(replica_axis, None),        # origins (R, chunk)
+            P(replica_axis, None),        # gen_ticks
+            P(replica_axis),              # seed (R,)
+        ) + ((P(replica_axis),) if per_replica_loss else ())
+        out_specs: tuple = (
+            P(replica_axis, NODES_AXIS),
+            P(replica_axis, NODES_AXIS),
+            P(replica_axis, NODES_AXIS),
+            P(replica_axis, None, None),  # coverage (psum'ed over nodes)
+        )
+        if tel:
+            out_specs = out_specs + (P(replica_axis, None, None),)
+        if dig:
+            out_specs = out_specs + (P(replica_axis, None),)
+        if delta:
+            out_specs = out_specs + (P(replica_axis, None),)
+    else:
+        in_specs = (
             P(NODES_AXIS, None),  # ell_idx
             P(NODES_AXIS, None),  # ell_delay
             P(NODES_AXIS),        # degree
@@ -535,38 +633,59 @@ def build_partnered_runner(
             P(SHARES_AXIS),       # origins
             P(SHARES_AXIS),       # gen_ticks
             P(),                  # seed
-        ),
-        out_specs=(
+        )
+        out_specs = (
             P(SHARES_AXIS, NODES_AXIS),
             P(SHARES_AXIS, NODES_AXIS),
             P(SHARES_AXIS, NODES_AXIS),
             P(SHARES_AXIS, None, None),  # coverage (psum'ed over nodes)
+        ) + (
+            ((P(SHARES_AXIS, None, None),) if tel else ())
+            + ((P(SHARES_AXIS, None),) if dig else ())
+            + ((P(SHARES_AXIS, None),) if delta else ())  # exchange ctrs
         )
-        + ((P(SHARES_AXIS, None, None),) if tel else ())
-        + ((P(SHARES_AXIS, None),) if dig else ())
-        + ((P(SHARES_AXIS, None),) if delta else ()),  # exchange counters
+    mapped = shard_map(
+        pass_fn,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
         check_vma=False,
     )
-    return jax.jit(mapped), n_share_shards * chunk_size
+    return jax.jit(mapped), (
+        chunk_size if campaign else n_share_shards * chunk_size
+    )
 
 
 # --- staticcheck audit spec (p2p_gossip_tpu/staticcheck/) -----------------
 
 def _audit_spec_partnered_runner(
-    protocol: str, telemetry_on: bool = False, exchange: str = "dense"
+    protocol: str, telemetry_on: bool = False, exchange: str = "dense",
+    campaign: bool = False,
 ):
     """Stage + build the sharded partnered runner on tiny shapes (same
     mesh policy as the flood audit spec). The u64 ``sent`` counter halves
     come back as (n_share_shards, n_padded) uint32 stacks, so the allowed
     uint32 minor dims include the padded row count alongside the bitmask
     word width. ``exchange`` "delta" audits the sparse seen-delta path
-    (sharded ring; both mirror-advance cond branches trace)."""
+    (sharded ring; both mirror-advance cond branches trace). ``campaign``
+    audits the replica-factorized mode on a (replicas, nodes) mesh — the
+    jit surface run_sharded_protocol_campaign dispatches."""
     from p2p_gossip_tpu.models.topology import erdos_renyi
-    from p2p_gossip_tpu.parallel.engine_sharded import _audit_mesh
+    from p2p_gossip_tpu.parallel.engine_sharded import (
+        _audit_campaign_mesh,
+        _audit_mesh,
+    )
     from p2p_gossip_tpu.staticcheck.registry import AuditSpec
     from p2p_gossip_tpu.telemetry.schema import NUM_METRICS
 
-    mesh, _ = _audit_mesh()
+    if campaign:
+        from p2p_gossip_tpu.parallel.mesh import REPLICAS_AXIS
+
+        mesh = _audit_campaign_mesh()
+        local_replicas = 2
+        r_batch = mesh.shape[REPLICAS_AXIS] * local_replicas
+    else:
+        mesh, _ = _audit_mesh()
     n_node_shards = mesh.shape[NODES_AXIS]
     graph = erdos_renyi(16, 0.3, seed=0)
     chunk, horizon = 32, 8
@@ -588,17 +707,30 @@ def _audit_spec_partnered_runner(
             (1 << 20, 7), False, ring_mode="sharded", delay_values=(1,),
             telemetry_on=telemetry_on, exchange_mode="delta",
             delta_capacity=capacity,
+            replica_axis=("replicas" if campaign else None),
+            local_replicas=(local_replicas if campaign else 1),
         )
     else:
         runner, pass_size = build_partnered_runner(
             mesh, protocol, n_padded, ring, chunk, horizon,
             2 if protocol == "pushk" else 1,
-            (1 << 20, 7), False, ring_mode="replicated",
+            (1 << 20, 7), False,
+            ring_mode=("sharded" if campaign else "replicated"),
+            delay_values=((1,) if campaign else None),
             telemetry_on=telemetry_on,
+            replica_axis=("replicas" if campaign else None),
+            local_replicas=(local_replicas if campaign else 1),
         )
-    origins = np.zeros(pass_size, dtype=np.int32)
-    gen_ticks = np.full(pass_size, horizon, dtype=np.int32)
-    gen_ticks[:2] = 0
+    if campaign:
+        origins = np.zeros((r_batch, pass_size), dtype=np.int32)
+        gen_ticks = np.full((r_batch, pass_size), horizon, dtype=np.int32)
+        gen_ticks[:, :2] = 0
+        churn_start = np.zeros((r_batch, n_padded, 1), dtype=np.int32)
+        churn_end = churn_start.copy()
+    else:
+        origins = np.zeros(pass_size, dtype=np.int32)
+        gen_ticks = np.full(pass_size, horizon, dtype=np.int32)
+        gen_ticks[:2] = 0
     words: tuple = (bitmask.num_words(chunk), n_padded)
     if telemetry_on:
         # Stacked per-shard digest rings are (1, horizon) uint32 — the
@@ -607,11 +739,15 @@ def _audit_spec_partnered_runner(
     if exchange == "delta":
         # Delta buffers (capacity minor dim) and the (1, 8) counter row.
         words = words + (capacity, 8)
+    seed = (
+        np.full(r_batch, 42, dtype=np.uint32) if campaign
+        else np.uint32(42)
+    )
     return AuditSpec(
         fn=runner,
         args=(
             ell_idx, ell_delays, degree, churn_start, churn_end,
-            origins, gen_ticks, np.uint32(42),
+            origins, gen_ticks, seed,
         ),
         integer_only=True,
         bitmask_words=words,
@@ -639,6 +775,10 @@ register_entry(
 register_entry(
     "parallel.protocols_sharded.pushpull_runner[delta]",
     spec=lambda: _audit_spec_partnered_runner("pushpull", exchange="delta"),
+)
+register_entry(
+    "parallel.protocols_sharded.pushpull_runner[campaign]",
+    spec=lambda: _audit_spec_partnered_runner("pushpull", campaign=True),
 )
 
 

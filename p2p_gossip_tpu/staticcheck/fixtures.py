@@ -37,7 +37,10 @@ Fixtures:
 
 from __future__ import annotations
 
-FIXTURES = ("f64", "recompile", "prng", "telemetry", "digest", "exchange")
+FIXTURES = (
+    "f64", "recompile", "prng", "telemetry", "digest", "exchange",
+    "meshfact",
+)
 
 
 def f64_fixture() -> dict:
@@ -233,6 +236,42 @@ def exchange_fixture() -> dict:
     }
 
 
+def meshfact_fixture() -> dict:
+    """Seeded axis-split drift: the campaign drivers bake the
+    (replicas, nodes) factorization into every jit signature, so
+    ``auto_axis_split`` must be stable under the few-percent wobble its
+    "rough" node-byte estimate is allowed (``estimate_node_bytes``
+    docstring) — an estimate that straddles a shard boundary silently
+    recompiles every campaign batch. The fixture lands the estimate ON
+    the 2-shard boundary and wobbles it +/-2%: a drift-stable model
+    expects ONE distinct split; the sentinel must measure two."""
+    from p2p_gossip_tpu.parallel.mesh import auto_axis_split
+    from p2p_gossip_tpu.staticcheck.recompile import SentinelReport
+
+    n_devices, hbm = 8, 1_000_000
+    # The seeded bug: node_bytes / 2 == hbm exactly, so +2% drift tips
+    # the factorization from (4, 2) to (2, 4).
+    base = 2 * hbm
+    splits = {
+        auto_axis_split(n_devices, int(base * drift), hbm_bytes=hbm)
+        for drift in (0.98, 1.0, 1.02)
+    }
+    expected = {"distinct_splits": 1}
+    measured = {"distinct_splits": len(splits)}
+    report = SentinelReport(
+        ok=measured == expected, expected=expected, measured=measured,
+        cells=3,
+    )
+    return {
+        "fixture": "meshfact",
+        "ok": report.ok,  # must come back False
+        "violations": [{"rule": "meshfact-sentinel", "message": m}
+                       for m in report.violations()],
+        "expected": expected,
+        "measured": measured,
+    }
+
+
 def run_fixture(name: str) -> dict:
     if name == "f64":
         return f64_fixture()
@@ -246,4 +285,6 @@ def run_fixture(name: str) -> dict:
         return digest_fixture()
     if name == "exchange":
         return exchange_fixture()
+    if name == "meshfact":
+        return meshfact_fixture()
     raise ValueError(f"unknown fixture {name!r}; valid: {FIXTURES}")

@@ -461,6 +461,41 @@ def main() -> int:
                       "state-slice exchange on the same run)")
             print()
 
+    csh = by_stage.get("campaign_sharded")
+    if csh and csh["results"]:
+        legs = [r for r in csh["results"] if "replica_shards" in r]
+        if legs:
+            print("## Campaigns × shards (factorized (replicas, nodes) "
+                  "mesh, per-replica bitwise-checked)\n")
+            print(md_table([
+                {
+                    "leg": f"{r.get('ring_mode')}/{r.get('exchange_mode')}",
+                    "platform": r.get("platform"),
+                    "nodes": r.get("nodes"),
+                    "topology": r.get("topology"),
+                    "mesh": (
+                        f"{r.get('replica_shards')}x{r.get('node_shards')}"
+                    ),
+                    "bitwise": (
+                        f"{r.get('bitwise_equal_replicas')}/"
+                        f"{r.get('replicas')}"
+                    ),
+                    "campaign_warm_s/replica": r.get(
+                        "campaign_warm_per_replica_s"),
+                    "solo_warm_s/replica": r.get(
+                        "solo_warm_per_replica_s"),
+                    "speedup": r.get("speedup_warm_per_replica"),
+                    "fresh_s": r.get("campaign_fresh_s"),
+                }
+                for r in legs
+            ], ["leg", "platform", "nodes", "topology", "mesh", "bitwise",
+                "campaign_warm_s/replica", "solo_warm_s/replica",
+                "speedup", "fresh_s"]))
+            if csh.get("pending_tpu"):
+                print("\n(host-mesh CPU record — pending_tpu: re-captured "
+                      "on the first window with a real multi-chip mesh)")
+            print()
+
     for stage, title in (
         ("scale1m", "1M north star (ER p=0.001, 64-share staging plan)"),
         ("scale1m_ba", "1M scale-free (BA m=3)"),

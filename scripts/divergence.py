@@ -29,6 +29,10 @@ Pairs:
                    delta's OR-monotone merge must be bit-identical, so
                    shard 0's digest streams must agree tick for tick
                    (skipped when fewer than 4 devices)
+  sharded-campaign solo node-sharded flood run vs replica 0 of the
+                   factorized (replicas x nodes) campaign
+                   (``batch.campaign_sharded``) on the same node-shard
+                   count (skipped when fewer than 4 devices)
 
 ``--inject-fault T`` is the bisector's self-test: after collecting each
 pair it flips one bit of the second stream's digest at tick T and
@@ -58,6 +62,7 @@ PAIRS = (
     "pushpull-campaign",
     "sync-sharded",
     "sync-delta",
+    "sharded-campaign",
 )
 
 
@@ -264,12 +269,53 @@ def pair_sync_delta(args):
     return dense, delta
 
 
+def pair_sharded_campaign(args):
+    import jax
+
+    if len(jax.devices()) < 4:
+        return None
+    from p2p_gossip_tpu.batch.campaign import flood_replicas
+    from p2p_gossip_tpu.batch.campaign_sharded import run_sharded_campaign
+    from p2p_gossip_tpu.parallel.engine_sharded import run_sharded_sim
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+    from p2p_gossip_tpu.telemetry import compare
+
+    graph, _ = _workload(args)
+    reps = flood_replicas(
+        graph, args.shares, [args.seed, args.seed + 1], args.horizon
+    )
+    devices = jax.devices()
+    # Factorized (2 replicas x 2 nodes) mesh vs a solo nodes-only mesh
+    # with the SAME node-shard count — the campaign's bitwise contract.
+    mesh_c = make_mesh(2, devices=devices[:4], replicas=2)
+    mesh_s = make_mesh(2, 1, devices=devices[:2])
+    solo_events = _capture_events(
+        lambda: run_sharded_sim(
+            graph, reps.replica_schedule(0, args.horizon), args.horizon,
+            mesh_s, chunk_size=args.shares,
+        )
+    )
+    camp_events = _capture_events(
+        lambda: run_sharded_campaign(graph, reps, args.horizon, mesh_c)
+    )
+    solo = compare.select_stream(
+        compare.digest_streams(solo_events), kernel="engine_sharded",
+        shard=0,
+    )
+    camp = compare.select_stream(
+        compare.digest_streams(camp_events), kernel="run_sharded_campaign",
+        replica=0,
+    )
+    return solo, camp
+
+
 _PAIR_FNS = {
     "native-sync": pair_native_sync,
     "sync-campaign": pair_sync_campaign,
     "pushpull-campaign": pair_pushpull_campaign,
     "sync-sharded": pair_sync_sharded,
     "sync-delta": pair_sync_delta,
+    "sharded-campaign": pair_sharded_campaign,
 }
 
 

@@ -14,6 +14,12 @@ so the only untested step to a physical v5e-8 is the hardware itself.
 Emits one JSON row per (N, ring_mode) on stdout; diagnostics on stderr.
 Usage: python scripts/mesh_rehearsal.py [--nodes 100000] [--prob 0.001]
        [--shares 64] [--devices 8] [--skip-parity]
+       [--replicas R]  # campaign rehearsal: R seed replicas of the
+       node-sharded graph as ONE compiled program on a factorized
+       (replica_shards x node_shards) mesh — per-replica bitwise compare
+       vs solo sharded runs, warm/fresh timings vs the sequential
+       solo-sharded loop (batch/campaign_sharded.py)
+       [--out FILE]    # also append every JSON row to FILE (artifact)
        [--protocol flood|pushpull|pull|pushk]   # partnered legs rehearse
        BASELINE config 5's anti-entropy on the same mesh/ring machinery
        [--exchange dense|delta|ab]  # sharded-ring wire format; "ab" runs
@@ -34,6 +40,163 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def _campaign_rehearsal(args, graph, delays, devices, emit) -> int:
+    """--replicas leg: one factorized-mesh campaign vs the sequential
+    solo-sharded loop it replaces. Certifies, per replica and per
+    exchange wire format, that the campaign counters are BITWISE the
+    solo node-sharded run's (same node-shard count, same share pad), and
+    times warm/fresh walls for both drivers — the throughput claim the
+    factorization makes (per-replica warm wall under the sequential
+    loop's) lands in the emitted row as ``speedup_warm_per_replica``."""
+    import jax
+    import numpy as np
+
+    from p2p_gossip_tpu.batch.campaign import flood_replicas
+    from p2p_gossip_tpu.batch.campaign_sharded import (
+        run_sharded_campaign,
+        run_sharded_protocol_campaign,
+    )
+    from p2p_gossip_tpu.ops.bitmask import num_words
+    from p2p_gossip_tpu.parallel.engine_sharded import run_sharded_sim
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+
+    r_shards = args.replica_shards
+    if args.devices % r_shards:
+        raise SystemExit(
+            f"--replica-shards {r_shards} must divide --devices "
+            f"{args.devices}"
+        )
+    n_node_shards = args.devices // r_shards
+    # Campaign mesh: replicas x nodes over ALL the devices. Solo
+    # baseline mesh: nodes-only with the SAME node-shard count — the
+    # mesh a sequential seed loop would actually run on, and the mesh
+    # the bitwise contract is stated against (campaign_sharded
+    # docstring: same node-shard count, same share pad).
+    mesh_c = make_mesh(
+        n_node_shards, devices=devices[: args.devices], replicas=r_shards
+    )
+    mesh_s = make_mesh(n_node_shards, 1, devices=devices[:n_node_shards])
+    reps = flood_replicas(
+        graph, args.shares,
+        list(range(args.seed, args.seed + args.replicas)), args.horizon,
+    )
+    n_delay_values = len(np.unique(delays[graph.ell()[1]]))
+
+    if args.protocol != "flood":
+        from p2p_gossip_tpu.parallel.protocols_sharded import (
+            run_sharded_partnered_sim,
+        )
+
+        sched_kw = {"protocol": args.protocol, "fanout": args.fanout}
+
+    exchanges = (
+        ("dense", "delta") if args.exchange == "ab" else (args.exchange,)
+    )
+    for exchange in exchanges:
+        if args.protocol == "flood":
+            def run_campaign():
+                return run_sharded_campaign(
+                    graph, reps, args.horizon, mesh_c, ell_delays=delays,
+                    block=args.block, exchange=exchange,
+                )
+
+            def run_solo(r):
+                return run_sharded_sim(
+                    graph, reps.replica_schedule(r, args.horizon),
+                    args.horizon, mesh_s, ell_delays=delays,
+                    block=args.block, exchange=exchange,
+                    chunk_size=reps.shares_per_replica,
+                )
+        else:
+            def run_campaign():
+                return run_sharded_protocol_campaign(
+                    graph, reps, args.horizon, mesh_c, ell_delays=delays,
+                    exchange=exchange, **sched_kw,
+                )
+
+            def run_solo(r):
+                return run_sharded_partnered_sim(
+                    graph, reps.replica_schedule(r, args.horizon),
+                    args.horizon, mesh_s, ell_delays=delays,
+                    seed=int(reps.seeds[r]) & 0xFFFFFFFF,
+                    exchange=exchange,
+                    chunk_size=reps.shares_per_replica, **sched_kw,
+                )
+
+        # Fresh = compile-inclusive (the one-program claim: ONE compile
+        # covers every replica); warm = steady-state batch wall.
+        t0 = time.perf_counter()
+        result = run_campaign()
+        fresh_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        result = run_campaign()
+        warm_s = time.perf_counter() - t0
+        log(f"campaign[{exchange}]: fresh {fresh_s:.1f}s, "
+            f"warm {warm_s:.1f}s ({warm_s / args.replicas:.2f}s/replica)")
+
+        # Sequential baseline: compile once on replica 0 (flood_replicas
+        # gives every replica identical shapes, so one executable serves
+        # the whole loop — the fairest version of the loop the campaign
+        # replaces), then time the warm R-replica loop with the bitwise
+        # check folded in.
+        t0 = time.perf_counter()
+        run_solo(0)
+        solo_fresh_s = time.perf_counter() - t0
+        equal = []
+        t0 = time.perf_counter()
+        for r in range(args.replicas):
+            st = run_solo(r)
+            ok = bool(
+                np.array_equal(st.received[: graph.n], result.received[r])
+                and np.array_equal(st.sent[: graph.n], result.sent[r])
+            )
+            equal.append(ok)
+            log(f"  replica {r}: solo-vs-campaign bitwise "
+                f"{'OK' if ok else 'MISMATCH'} (received + sent)")
+        solo_loop_s = time.perf_counter() - t0
+        assert all(equal), f"campaign diverges from solo loop: {equal}"
+
+        ring = result.extra["ring"]
+        row = {
+            "rehearsal": (
+                "campaign_sharded" if args.protocol == "flood"
+                else f"campaign_sharded_{args.protocol}"
+            ),
+            "platform": jax.devices()[0].platform,
+            "nodes": graph.n,
+            "topology": args.topology,
+            "edges": graph.num_edges,
+            "devices": args.devices,
+            "replicas": args.replicas,
+            "replica_shards": r_shards,
+            "node_shards": n_node_shards,
+            "local_replicas": result.extra["mesh"]["local_replicas"],
+            "shares_per_replica": args.shares,
+            "horizon": args.horizon,
+            "delay_values": int(n_delay_values),
+            "exchange_mode": exchange,
+            "ring_mode": ring["mode"],
+            "ring_bytes_per_chip": ring["bytes_per_chip"],
+            "pad_shares": num_words(args.shares) * 32,
+            "bitwise_equal_replicas": int(sum(equal)),
+            "campaign_fresh_s": round(fresh_s, 2),
+            "campaign_warm_s": round(warm_s, 2),
+            "campaign_warm_per_replica_s": round(warm_s / args.replicas, 3),
+            "solo_fresh_s": round(solo_fresh_s, 2),
+            "solo_loop_warm_s": round(solo_loop_s, 2),
+            "solo_warm_per_replica_s": round(solo_loop_s / args.replicas, 3),
+            "speedup_warm_per_replica": round(solo_loop_s / warm_s, 2),
+        }
+        ex = result.extra.get("exchange")
+        if ex is not None:
+            row["exchange"] = ex
+        emit(row)
+        log(f"campaign[{exchange}]: {sum(equal)}/{args.replicas} replicas "
+            f"bitwise-equal, warm speedup x{row['speedup_warm_per_replica']}"
+            f" per replica vs sequential solo loop")
+    return 0
 
 
 def main() -> int:
@@ -91,6 +254,27 @@ def main() -> int:
         "under the graph's build fingerprint",
     )
     ap.add_argument(
+        "--replicas", type=int, default=0,
+        help="R > 0 switches to the CAMPAIGN rehearsal: R seed replicas "
+        "of the node-sharded graph as ONE compiled program on a "
+        "factorized (replica_shards x node_shards) mesh "
+        "(batch/campaign_sharded.py) — each replica checked bitwise vs "
+        "its solo sharded run, with warm/fresh timings vs the "
+        "sequential solo-sharded loop; works with --protocol and "
+        "--exchange (ab runs dense and delta legs)",
+    )
+    ap.add_argument(
+        "--replica-shards", type=int, default=2,
+        help="replica-axis device count for --replicas (node shards "
+        "take the rest: 8 devices, 2 replica shards -> a (2, 4) mesh); "
+        "must divide --devices",
+    )
+    ap.add_argument(
+        "--out", type=str, default="",
+        help="also append every emitted JSON row to this file (the "
+        "docs/artifacts/ path for committed evidence)",
+    )
+    ap.add_argument(
         "--skip-parity", action="store_true",
         help="skip the single-device parity run (halves the wall time); "
         "flood runs still check counter conservation, and every run "
@@ -132,6 +316,13 @@ def main() -> int:
     devices = jax.devices("cpu")
     assert len(devices) >= args.devices, devices
     mesh = make_mesh(args.devices, 1, devices=devices[: args.devices])
+
+    def emit(row: dict) -> None:
+        line = json.dumps(row)
+        print(line, flush=True)
+        if args.out:
+            with open(args.out, "a") as f:
+                f.write(line + "\n")
 
     # Cache protocol shared with scale_1m.py (same fingerprint, same
     # load/validate/build/save semantics), so /tmp/er1m.npz built by
@@ -204,6 +395,9 @@ def main() -> int:
         graph, mean_ticks=2.0, sigma=0.6, max_ticks=args.delay_max_ticks,
         seed=args.seed,
     )
+
+    if args.replicas:
+        return _campaign_rehearsal(args, graph, delays, devices, emit)
 
     # Host-fit arithmetic (shared by the auto-shrink preflight below and
     # the emitted rows): the virtual mesh concentrates every shard in ONE
@@ -408,7 +602,7 @@ def main() -> int:
                f" words/tick (occ "
                f"{ex.get('delta_occupancy', 0):.3f})"
                if ex is not None and ex.get("mode") == "delta" else ""))
-        print(json.dumps(row), flush=True)
+        emit(row)
 
     # Every pair of legs must agree bitwise — a check that costs nothing
     # (all already ran) and survives --skip-parity, so even 1M

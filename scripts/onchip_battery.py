@@ -52,6 +52,13 @@ Stages (value-first within safety bands — see the note after the list):
                chip's compiler. Tiny sims + standard XLA — safe band,
                right after telemetry validated the same instrumented
                kernels.
+  campaign_sharded — mesh_rehearsal.py --replicas 4 at the acceptance
+               shape (100K BA, (2 replicas x 4 nodes) split, dense +
+               delta legs): the factorized campaigns-x-shards program
+               with per-replica bitwise checks and warm/fresh walls vs
+               the sequential solo-sharded loop. Host-mesh CPU by
+               design (like exchange); records carry pending_tpu until
+               a real multi-chip mesh is attached.
   scale1m   — scale_1m.py --shares 64 --chunk 64 -> the 1M ER on-chip
                line at the minimal resident footprint (pad W=2, ~5.2 GB
                modeled = essentially the bare ELL). The full-config
@@ -127,8 +134,19 @@ ART_DIR = os.path.join(REPO, "docs", "artifacts")
 STAGE_ORDER = (
     "bench", "protocols", "kernel", "bench_rep2", "bench_rep3",
     "campaign", "staticcheck", "telemetry", "flightrec", "exchange",
+    "campaign_sharded",
     "scale1m", "scale1m_ba", "sweep250", "profile", "scale1m_full",
 )
+
+# Host-mesh stages: mesh_rehearsal.py pins JAX_PLATFORMS=cpu by design
+# (the delta exchange and the factorized campaign mesh need >= 4 devices;
+# the tunnel attaches one chip), so their records are CPU mechanics
+# evidence, not chip numbers. Each record is stamped ``pending_tpu``
+# until a run happens with a real multi-chip TPU mesh attached —
+# --skip-done stops counting a pending record as done the moment the
+# probe sees such a mesh, so the first multi-chip window re-runs these
+# rows on hardware (ROADMAP: PR 11 exchange follow-up).
+PENDING_TPU_STAGES = ("exchange", "campaign_sharded")
 
 
 def log(msg: str) -> None:
@@ -154,6 +172,35 @@ def tunnel_healthy(probe_timeout_s: float = 150.0) -> bool:
     if not ok:
         log(f"health probe failed: {err}")
     return ok
+
+
+def multichip_attached(probe_timeout_s: float = 150.0) -> bool:
+    """True iff the attached device set is a real multi-chip TPU mesh
+    (>= 4 chips) — the signal that the host-mesh stages' pending_tpu
+    records are finally upgradable to hardware evidence. Killable
+    subprocess for the same wedged-tunnel reason as tunnel_healthy;
+    any failure reads as "no mesh" (the conservative answer: pending
+    records keep counting as done and no window is burned re-running
+    CPU stages). Memoized — the skip-done scan and the per-record
+    stamping both ask, and one probe per battery run is enough."""
+    global _MULTICHIP
+    if _MULTICHIP is None:
+        snippet = (
+            "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+        )
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", snippet], check=True,
+                timeout=probe_timeout_s, capture_output=True, text=True,
+                env=stage_env(),
+            ).stdout.split()
+            _MULTICHIP = out[0] == "tpu" and int(out[1]) >= 4
+        except Exception:
+            _MULTICHIP = False
+    return _MULTICHIP
+
+
+_MULTICHIP: bool | None = None
 
 
 def stage_specs(args) -> dict:
@@ -251,6 +298,20 @@ def stage_specs(args) -> dict:
                     py, os.path.join(SCRIPTS, "divergence.py"), "--json",
                     "--n", "64", "--shares", "3", "--horizon", "16",
                     "--with-cost", "engine.sync._run_chunk_while",
+                ],
+                "env": cpu,
+                "budget": args.stage_budget or 900,
+            },
+            "campaign_sharded": {
+                # Factorized (replicas x nodes) campaign at smoke
+                # shapes: 4 replicas on a (2, 4) virtual mesh, dense +
+                # delta legs, every replica bitwise-checked against its
+                # solo sharded run inside the script.
+                "argv": [
+                    py, os.path.join(SCRIPTS, "mesh_rehearsal.py"),
+                    "--nodes", "2000", "--prob", "0.01", "--shares", "16",
+                    "--horizon", "24", "--replicas", "4",
+                    "--replica-shards", "2", "--exchange", "ab",
                 ],
                 "env": cpu,
                 "budget": args.stage_budget or 900,
@@ -436,6 +497,26 @@ def stage_specs(args) -> dict:
                 "--topology", "ba", "--nodes", "100000", "--baM", "3",
                 "--shares", "64", "--horizon", "48", "--exchange", "ab",
                 "--partition", "--skip-parity",
+            ],
+            "env": sweep_env,
+            "budget": args.stage_budget or 3600,
+        },
+        "campaign_sharded": {
+            # Campaigns x shards at the acceptance shape: R=4 replicas
+            # of the node-sharded 100K BA graph as ONE compiled program
+            # on the (2 replicas x 4 nodes) 8-virtual-device host mesh,
+            # dense AND delta legs, each replica bitwise-checked against
+            # its solo sharded run, warm/fresh walls vs the sequential
+            # solo-sharded loop in the rows. mesh_rehearsal pins
+            # JAX_PLATFORMS=cpu by design (PENDING_TPU_STAGES note) —
+            # this is mechanics + throughput-factorization evidence, not
+            # a chip perf number, and the record stays pending_tpu until
+            # a real multi-chip mesh is attached.
+            "argv": [
+                py, os.path.join(SCRIPTS, "mesh_rehearsal.py"),
+                "--topology", "ba", "--nodes", "100000", "--baM", "3",
+                "--shares", "64", "--horizon", "48", "--replicas", "4",
+                "--replica-shards", "2", "--exchange", "ab",
             ],
             "env": sweep_env,
             "budget": args.stage_budget or 3600,
@@ -659,6 +740,19 @@ def main() -> int:
     if args.skip_done:
         prior = latest_records(args.art_dir)
         done = {n for n, rec in prior.items() if rec.get("ok")}
+        # pending_tpu rows (host-mesh stages recorded without a real
+        # multi-chip mesh attached) stop counting as done the moment
+        # the probe sees >= 4 real chips: the first multi-chip window
+        # re-captures them on hardware. Probe only when it matters —
+        # a pending record exists among the wanted stages.
+        pending = {
+            s for s in stages
+            if s in done and prior[s].get("pending_tpu")
+        }
+        if pending and probing and multichip_attached():
+            log(f"multi-chip mesh attached: re-running pending-TPU "
+                f"stages {sorted(pending)}")
+            done -= pending
         summary["skipped_done"] = [s for s in stages if s in done]
         for s in summary["skipped_done"]:
             # Counts as ok for the exit code: its evidence already
@@ -689,6 +783,13 @@ def main() -> int:
             # Mark so done_stages never counts CPU smoke runs as on-chip
             # evidence (and artifact readers can tell them apart).
             rec["smoke"] = True
+        if name in PENDING_TPU_STAGES and not (
+            probing and multichip_attached()
+        ):
+            # Host-mesh CPU evidence: a real multi-chip record is still
+            # owed (see PENDING_TPU_STAGES) — --skip-done re-runs this
+            # stage on the first window that attaches such a mesh.
+            rec["pending_tpu"] = True
         persist(rec)
         summary["stages"][name] = {"ok": rec["ok"], "rc": rec["rc"]}
         remaining = stages[i + 1:]

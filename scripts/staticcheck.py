@@ -3,7 +3,8 @@
     python scripts/staticcheck.py              # human report
     python scripts/staticcheck.py --json       # one JSON line on stdout
     python scripts/staticcheck.py --fixture f64|recompile|prng|
-                                           telemetry|digest|exchange
+                                           telemetry|digest|exchange|
+                                           meshfact
     python scripts/staticcheck.py --compile    # also lower+compile each
                                                # audited entry on the
                                                # default device (the
@@ -96,7 +97,7 @@ def main() -> int:
                     help="one JSON line on stdout instead of the human report")
     ap.add_argument("--fixture",
                     choices=("f64", "recompile", "prng", "telemetry",
-                             "digest", "exchange"),
+                             "digest", "exchange", "meshfact"),
                     help="run one seeded regression fixture; exits non-zero "
                     "iff the analyzer (correctly) flags it")
     ap.add_argument("--lint-only", action="store_true",

@@ -265,6 +265,17 @@ def main() -> int:
     if other_instance_alive(args.log):
         log_line(args.log, {"event": "skip", "reason": "instance alive"})
         return 0
+    if os.path.exists(pid_path(args.log)):
+        # A dead watcher (killed session, OOM) leaves its pid file behind;
+        # other_instance_alive already proved nothing live owns it, so
+        # clear it here with an audit record instead of requiring the
+        # manual `rm -f` the session-bootstrap snippet used to carry.
+        log_line(args.log, {"event": "stale_pid_cleared",
+                            "path": pid_path(args.log)})
+        try:
+            os.unlink(pid_path(args.log))
+        except OSError:
+            pass
     write_pid(args.log)
     try:
         return watch_loop(args)

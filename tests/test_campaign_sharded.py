@@ -1,0 +1,284 @@
+"""Campaigns x shards: every replica of a factorized (replicas, nodes)
+campaign must be bitwise the solo node-sharded run with the same seed —
+dense and delta exchange, with churn and loss, for every axis split —
+and the batch axis must stay a pure throughput lever (checkpoint resume,
+digest streams, ensemble stats all unchanged)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import p2p_gossip_tpu as pg
+from p2p_gossip_tpu.batch.campaign import ReplicaSet, flood_replicas
+from p2p_gossip_tpu.batch.campaign_sharded import (
+    run_sharded_campaign,
+    run_sharded_protocol_campaign,
+)
+from p2p_gossip_tpu.batch.stats import ensemble_summary
+from p2p_gossip_tpu.models.linkloss import LinkLossModel
+from p2p_gossip_tpu.parallel.engine_sharded import (
+    run_sharded_flood_coverage,
+    run_sharded_sim,
+)
+from p2p_gossip_tpu.parallel.mesh import (
+    NODES_AXIS,
+    REPLICAS_AXIS,
+    auto_axis_split,
+    estimate_node_bytes,
+    make_mesh,
+)
+from p2p_gossip_tpu.parallel.protocols_sharded import run_sharded_partnered_sim
+
+
+def _campaign_mesh(replica_shards, node_shards):
+    n = replica_shards * node_shards
+    return make_mesh(
+        node_shards, devices=jax.devices("cpu")[:n], replicas=replica_shards
+    )
+
+
+def _solo_mesh(node_shards):
+    return make_mesh(
+        node_shards, 1, devices=jax.devices("cpu")[:node_shards]
+    )
+
+
+def _replica_set(graph, R=4, S=10, horizon=40, seed=11, churn=False):
+    rng = np.random.default_rng(seed)
+    origins = rng.integers(0, graph.n, size=(R, S)).astype(np.int32)
+    gen_ticks = rng.integers(0, 6, size=(R, S)).astype(np.int32)
+    gen_ticks[-1, S - 3 :] = horizon  # sentinel tail: uneven live shares
+    seeds = np.arange(300, 300 + R, dtype=np.int64)
+    ch = None
+    if churn:
+        cs = rng.integers(0, 10, size=(R, graph.n, 2)).astype(np.int32)
+        ce = cs + rng.integers(0, 6, size=(R, graph.n, 2)).astype(np.int32)
+        ch = (cs, ce)
+    return ReplicaSet(graph.n, origins, gen_ticks, seeds, churn=ch)
+
+
+def test_mesh_factorization_helpers():
+    mesh = _campaign_mesh(2, 4)
+    assert mesh.shape[REPLICAS_AXIS] == 2 and mesh.shape[NODES_AXIS] == 4
+    # Explicit replica count with node shards derived from the remainder.
+    mesh = make_mesh(devices=jax.devices("cpu"), replicas=4)
+    assert mesh.shape[REPLICAS_AXIS] == 4 and mesh.shape[NODES_AXIS] == 2
+    # Auto split: smallest node-shard count whose slice fits the budget.
+    assert auto_axis_split(8, node_bytes=None) == (8, 1)
+    assert auto_axis_split(8, node_bytes=3_000_000, hbm_bytes=1_000_000) == (
+        2, 4,
+    )
+    assert auto_axis_split(8, node_bytes=10**12, hbm_bytes=1_000_000) == (
+        1, 8,
+    )
+    nb = estimate_node_bytes(1 << 20, 16, 4)
+    auto = make_mesh(
+        devices=jax.devices("cpu"), replicas="auto", node_bytes=nb,
+        hbm_bytes=nb,  # whole graph fits one device -> all replicas
+    )
+    assert auto.shape[REPLICAS_AXIS] == 8 and auto.shape[NODES_AXIS] == 1
+
+
+def test_campaign_rejects_non_factorized_mesh():
+    g = pg.erdos_renyi(32, 0.15, seed=0)
+    reps = flood_replicas(g, 4, [0, 1], 16)
+    with pytest.raises(ValueError, match="replicas"):
+        run_sharded_campaign(g, reps, 16, _solo_mesh(2))
+
+
+@pytest.mark.parametrize("split", [(2, 4), (4, 2)])
+def test_campaign_parity_dense_all_axis_splits(split):
+    """Every replica bitwise vs its solo node-sharded run, on both
+    uneven factorizations of the 8-device host mesh."""
+    g = pg.erdos_renyi(72, 0.08, seed=4)
+    horizon = 40
+    reps = _replica_set(g, horizon=horizon)
+    res = run_sharded_campaign(g, reps, horizon, _campaign_mesh(*split))
+    assert res.received.shape == (4, g.n)
+    assert res.extra["mesh"]["replica_shards"] == split[0]
+    solo_mesh = _solo_mesh(split[1])
+    for r in range(4):
+        st = run_sharded_sim(
+            g, reps.replica_schedule(r, horizon), horizon, solo_mesh,
+            chunk_size=reps.shares_per_replica,
+        )
+        np.testing.assert_array_equal(st.received[: g.n], res.received[r])
+        np.testing.assert_array_equal(st.sent[: g.n], res.sent[r])
+
+
+def test_campaign_parity_delta_loss_churn():
+    """The sparse frontier-delta exchange under vmap, with per-replica
+    churn intervals and per-replica loss seeds: replica r must equal a
+    solo delta run with LinkLossModel(seed=loss_seeds[r])."""
+    g = pg.erdos_renyi(72, 0.08, seed=5)
+    horizon = 40
+    reps = _replica_set(g, horizon=horizon, churn=True)
+    loss = LinkLossModel(0.2, seed=77)
+    lseeds = [1001, 1002, 1003, 1004]
+    res = run_sharded_campaign(
+        g, reps, horizon, _campaign_mesh(2, 4), loss=loss, loss_seeds=lseeds,
+        ring_mode="sharded", exchange="delta",
+    )
+    assert res.extra["exchange"]["mode"] == "delta"
+    for r in range(4):
+        st = run_sharded_sim(
+            g, reps.replica_schedule(r, horizon), horizon, _solo_mesh(4),
+            chunk_size=reps.shares_per_replica,
+            churn=reps.replica_churn(r),
+            loss=LinkLossModel(0.2, seed=lseeds[r]),
+            ring_mode="sharded", exchange="delta",
+        )
+        np.testing.assert_array_equal(st.received[: g.n], res.received[r])
+        np.testing.assert_array_equal(st.sent[: g.n], res.sent[r])
+
+
+def test_campaign_shared_loss_seed_matches_solo():
+    """A shared LinkLossModel (no per-replica seeds) must reproduce the
+    solo run with the model's own static seed for every replica."""
+    g = pg.erdos_renyi(64, 0.09, seed=6)
+    horizon = 32
+    reps = _replica_set(g, R=2, horizon=horizon)
+    loss = LinkLossModel(0.3, seed=9)
+    res = run_sharded_campaign(
+        g, reps, horizon, _campaign_mesh(2, 2), loss=loss
+    )
+    for r in range(2):
+        st = run_sharded_sim(
+            g, reps.replica_schedule(r, horizon), horizon, _solo_mesh(2),
+            chunk_size=reps.shares_per_replica, loss=loss,
+        )
+        np.testing.assert_array_equal(st.received[: g.n], res.received[r])
+
+
+def test_campaign_coverage_matches_solo_flood():
+    g = pg.erdos_renyi(64, 0.09, seed=7)
+    horizon = 32
+    reps = flood_replicas(g, 6, [41, 42, 43, 44], horizon)
+    res = run_sharded_campaign(
+        g, reps, horizon, _campaign_mesh(2, 4), record_coverage=True
+    )
+    assert res.coverage.shape == (4, horizon, 6)
+    for r in range(4):
+        _, cov = run_sharded_flood_coverage(
+            g, reps.origins[r], horizon, _solo_mesh(4),
+            chunk_size=reps.shares_per_replica,
+        )
+        np.testing.assert_array_equal(np.asarray(cov)[:, :6], res.coverage[r])
+    # Ensemble statistics reuse batch/stats.py unchanged.
+    summary = ensemble_summary(res, 0.99)
+    assert summary["replicas"] == 4 and "ttc" in summary
+
+
+@pytest.mark.parametrize("exchange", ["dense", "delta"])
+def test_protocol_campaign_parity(exchange):
+    """Push-pull campaign: replica r bitwise vs the solo partnered run
+    with seed=replicas.seeds[r], under churn + per-replica loss, dense
+    and delta exchange."""
+    g = pg.erdos_renyi(64, 0.09, seed=8)
+    horizon = 12
+    reps = _replica_set(g, horizon=horizon, churn=True)
+    loss = LinkLossModel(0.25, seed=3)
+    lseeds = [71, 72, 73, 74]
+    res = run_sharded_protocol_campaign(
+        g, reps, horizon, _campaign_mesh(2, 4), protocol="pushpull",
+        loss=loss, loss_seeds=lseeds, exchange=exchange,
+    )
+    for r in range(4):
+        st = run_sharded_partnered_sim(
+            g, reps.replica_schedule(r, horizon), horizon, _solo_mesh(4),
+            protocol="pushpull", seed=int(reps.seeds[r]) & 0xFFFFFFFF,
+            chunk_size=reps.shares_per_replica,
+            churn=reps.replica_churn(r),
+            loss=LinkLossModel(0.25, seed=lseeds[r]), exchange=exchange,
+        )
+        np.testing.assert_array_equal(st.received[: g.n], res.received[r])
+        np.testing.assert_array_equal(st.sent[: g.n], res.sent[r])
+
+
+def test_campaign_checkpoint_resume_mid_campaign(tmp_path):
+    """Batch-boundary resume: a run stopped after one of two batches,
+    resumed from its checkpoint, must equal the uninterrupted campaign —
+    and the interrupted partial must genuinely differ."""
+    g = pg.erdos_renyi(64, 0.09, seed=9)
+    horizon = 32
+    reps = _replica_set(g, R=4, horizon=horizon)
+    mesh = _campaign_mesh(2, 4)
+    path = str(tmp_path / "campaign.npz")
+    want = run_sharded_campaign(g, reps, horizon, mesh, batch_size=2)
+    partial = run_sharded_campaign(
+        g, reps, horizon, mesh, batch_size=2,
+        checkpoint_path=path, stop_after_batches=1,
+    )
+    assert not (partial.received == want.received).all()
+    resumed = run_sharded_campaign(
+        g, reps, horizon, mesh, batch_size=2, checkpoint_path=path
+    )
+    np.testing.assert_array_equal(resumed.received, want.received)
+    np.testing.assert_array_equal(resumed.sent, want.sent)
+
+
+def test_campaign_batch_rounding_and_sentinel_padding():
+    """R=3 replicas over 2 replica shards: the batch rounds up to 4 with
+    a sentinel replica whose rows are dropped — counters must match the
+    exact R=4 superset run."""
+    g = pg.erdos_renyi(48, 0.12, seed=10)
+    horizon = 24
+    reps4 = _replica_set(g, R=4, S=8, horizon=horizon)
+    reps3 = ReplicaSet(
+        g.n, reps4.origins[:3], reps4.gen_ticks[:3], reps4.seeds[:3]
+    )
+    mesh = _campaign_mesh(2, 2)
+    res3 = run_sharded_campaign(g, reps3, horizon, mesh)
+    res4 = run_sharded_campaign(g, reps4, horizon, mesh)
+    assert res3.received.shape == (3, g.n)
+    np.testing.assert_array_equal(res3.received, res4.received[:3])
+
+
+def test_campaign_digest_streams_match_solo():
+    """Flight-recorder contract behind scripts/divergence.py's
+    sharded-campaign pair: replica r's per-tick digest stream equals the
+    solo node-sharded run's stream tick for tick."""
+    import tempfile
+
+    from p2p_gossip_tpu import telemetry
+    from p2p_gossip_tpu.telemetry import compare
+
+    g = pg.erdos_renyi(48, 0.12, seed=12)
+    horizon = 24
+    reps = flood_replicas(g, 4, [51, 52], horizon)
+
+    def capture(path, run):
+        telemetry.configure(path, rings=True)
+        try:
+            run()
+        finally:
+            telemetry.close()
+        events = list(telemetry.events())
+        telemetry.reset()
+        return events
+
+    with tempfile.TemporaryDirectory() as td:
+        camp_events = capture(
+            td + "/camp.jsonl",
+            lambda: run_sharded_campaign(
+                g, reps, horizon, _campaign_mesh(2, 2)
+            ),
+        )
+        solo_events = capture(
+            td + "/solo.jsonl",
+            lambda: run_sharded_sim(
+                g, reps.replica_schedule(1, horizon), horizon, _solo_mesh(2),
+                chunk_size=4,
+            ),
+        )
+    camp = compare.select_stream(
+        compare.digest_streams(camp_events), kernel="run_sharded_campaign",
+        replica=1,
+    )
+    solo = compare.select_stream(
+        compare.digest_streams(solo_events), kernel="engine_sharded", shard=0
+    )
+    assert camp and camp == solo
+    div = compare.first_divergence(solo, camp)
+    assert not div.diverged and div.compared == len(solo)

@@ -562,7 +562,8 @@ def test_tunnel_watch_second_instance_skips(tmp_path):
         holder.kill()
 
     # Same live pid, cmdline without 'tunnel_watch': treated as stale —
-    # the watcher proceeds (probe fails fast under a bogus backend).
+    # the watcher clears the pid file (audit-logged) and proceeds (probe
+    # fails fast under a bogus backend).
     log2 = tmp_path / "watch2.log"
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO
@@ -580,7 +581,7 @@ def test_tunnel_watch_second_instance_skips(tmp_path):
         assert r2.returncode == 1, r2.stderr[-2000:]
         events2 = [json.loads(line)["event"]
                    for line in log2.read_text().splitlines()]
-        assert events2 == ["watch_start", "probe"]
+        assert events2 == ["stale_pid_cleared", "watch_start", "probe"]
     finally:
         stale.kill()
 
