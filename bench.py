@@ -478,6 +478,62 @@ def main() -> None:
             log(f"campaign-sharded leg: did not complete "
                 f"({type(e).__name__})")
 
+    # Bounded-staleness async ticks (parallel/async_ticks.py): sync vs
+    # async K in {1,2} flood legs from the rehearsal script on the same
+    # 8-virtual-device CPU subprocess pattern as the campaign leg above.
+    # The rehearsal asserts K=1 bitwise-equal and K=2 fixed-point-equal
+    # before timing, so every row here is parity-certified; one compact
+    # entry per leg carries wall_s/tick and the modeled overlap
+    # fraction. Platform-labeled "cpu"; chip-scale numbers are the
+    # battery's async_ticks stage. None on smoke or when the leg could
+    # not run.
+    async_ticks = None
+    if not smoke:
+        at_args = [sys.executable, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts",
+            "mesh_rehearsal.py"), "--nodes", "4000", "--prob", "0.003",
+            "--shares", "32", "--horizon", "32", "--devices", "8",
+            "--async-k", "1,2"]
+        try:
+            atr = subprocess.run(
+                at_args, capture_output=True, text=True, timeout=600,
+                env=sc_env,
+            )
+            if atr.returncode == 0:
+                legs = []
+                for line in atr.stdout.strip().splitlines():
+                    try:
+                        leg = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    legs.append({
+                        "ring_mode": leg.get("ring_mode"),
+                        "exchange_mode": leg.get("exchange_mode"),
+                        "async_k": leg.get("async_k"),
+                        "wall_s": leg.get("wall_s"),
+                        "wall_per_tick_s": leg.get("wall_per_tick_s"),
+                        "modeled_overlap_fraction": (
+                            leg.get("exchange") or {}
+                        ).get("modeled_overlap_fraction"),
+                    })
+                if legs:
+                    async_ticks = {"platform": "cpu", "legs": legs}
+                    log(
+                        "async-ticks leg: "
+                        + "; ".join(
+                            f"{lg['exchange_mode']}"
+                            + (f"/K{lg['async_k']}" if lg["async_k"] else "")
+                            + f" {lg['wall_per_tick_s']}s/tick"
+                            for lg in legs
+                        )
+                        + " (cpu subprocess, parity-certified)"
+                    )
+            else:
+                log(f"async-ticks leg: FAIL (rc={atr.returncode}) "
+                    f"{atr.stderr[-400:]}")
+        except Exception as e:
+            log(f"async-ticks leg: did not complete ({type(e).__name__})")
+
     row = {
         "metric": (
             f"node-updates/sec ({n // 1000}K-node p={p:g} gossip "
@@ -527,6 +583,11 @@ def main() -> None:
         # bitwise-checked per replica); None on smoke or when it could
         # not run.
         "campaign_sharded": campaign_sharded,
+        # Sync-vs-async flood legs from the rehearsal script (bounded
+        # staleness, parallel/async_ticks.py): wall per tick and modeled
+        # overlap fraction per leg, every leg parity-certified before
+        # timing. None on smoke or when the leg could not run.
+        "async_ticks": async_ticks,
     }
     row["campaign"] = {
         "metric": (

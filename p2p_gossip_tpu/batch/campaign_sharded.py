@@ -60,6 +60,7 @@ from p2p_gossip_tpu.batch.campaign import (
 from p2p_gossip_tpu.engine.sync import MIN_CHUNK_SHARES
 from p2p_gossip_tpu.models.topology import Graph
 from p2p_gossip_tpu.ops import bitmask
+from p2p_gossip_tpu.parallel import async_ticks
 from p2p_gossip_tpu.parallel.mesh import NODES_AXIS, REPLICAS_AXIS
 from p2p_gossip_tpu import telemetry
 from p2p_gossip_tpu.telemetry import digest as tel_digest
@@ -172,6 +173,7 @@ def run_sharded_campaign(
     ring_mode: str = "auto",
     bucket_min_rows: int = 2048,
     exchange: str = "dense",
+    async_k: int = 2,
 ) -> CampaignResult:
     """Seed-ensemble flood campaign over a factorized (replicas, nodes)
     mesh: R replicas of the node-sharded flood engine in one jitted
@@ -186,8 +188,12 @@ def run_sharded_campaign(
     `models.seeds.replica_loss_seeds`) gives independent erasure streams.
     ``exchange`` "dense"/"delta"/"auto" resolves like run_sharded_sim —
     the delta capacity is planned once from the shared partition edge cut
-    and reused by every replica. Resolved ring/exchange reports land in
-    ``result.extra``."""
+    and reused by every replica — and the async spellings
+    ("async"/"async-dense"/"async-delta" with ``async_k`` = K) switch
+    every replica to the bounded-staleness read path, exactly as
+    `run_sharded_sim` does (replica r stays bitwise its solo async run,
+    i.e. its sync run with cross-shard delays clamped to max(d, K)).
+    Resolved ring/exchange reports land in ``result.extra``."""
     from p2p_gossip_tpu.parallel.engine_sharded import (
         _resolve_and_stage_ring,
         _stage_sharded_inputs,
@@ -195,6 +201,10 @@ def run_sharded_campaign(
     )
 
     replica_shards, n_node_shards = _campaign_mesh_dims(mesh)
+    transport, k_async = async_ticks.parse_exchange(exchange, async_k)
+    exchange = transport
+    if k_async:
+        ring_mode = "sharded"
     r_total = replicas.num_replicas
     s = replicas.shares_per_replica
     batch_size = _resolve_campaign_batch(replicas, batch_size, replica_shards)
@@ -205,6 +215,7 @@ def run_sharded_campaign(
      _cs0, _ce0) = _stage_sharded_inputs(
         graph, ell_delays, constant_delay, mesh, block, None
     )
+    ring = async_ticks.effective_ring(ring, k_async)
     (ring_mode, ell_args, delay_values, bucket_counts, ring_extra,
      exchange_plan) = _resolve_and_stage_ring(
         ring_mode, uniform, ring, n_padded, n_node_shards,
@@ -213,6 +224,13 @@ def run_sharded_campaign(
     )
     exchange_mode, need, capacity, exchange_extra = exchange_plan
     delta_on = exchange_mode == "delta"
+    if k_async:
+        exchange_extra.update(async_ticks.modeled_overlap_report(
+            exchange_mode,
+            (uniform,) if uniform is not None else delay_values,
+            k_async, n_node_shards, n_padded // n_node_shards,
+            bitmask.num_words(chunk), capacity,
+        ))
 
     loss_cfg, lseed_arr = _resolve_loss(loss, loss_seeds, r_total)
     static_loss, lseed_arr = _campaign_loss_seeds(loss_cfg, lseed_arr, r_total)
@@ -228,6 +246,7 @@ def run_sharded_campaign(
         exchange_mode=exchange_mode, delta_capacity=capacity,
         replica_axis=REPLICAS_AXIS, local_replicas=rb,
         per_replica_loss=(loss is not None),
+        async_k=k_async,
     )
 
     received = np.zeros((r_total, n_padded), dtype=np.int64)
@@ -250,6 +269,9 @@ def run_sharded_campaign(
             chunk, replica_shards, n_node_shards, batch_size,
             ell_delays if ell_delays is not None else constant_delay,
             ring_mode, exchange_mode, int(record_coverage),
+            # Async K >= 2 changes results (bounded staleness on
+            # cross-shard folds) — resumes must not mix with sync runs.
+            *(["async", k_async] if k_async else []),
             replicas.churn[0] if replicas.churn is not None else None,
             replicas.churn[1] if replicas.churn is not None else None,
             *(["loss", static_loss[0]] if static_loss else []),
@@ -390,13 +412,17 @@ def run_sharded_protocol_campaign(
     stop_after_batches: int | None = None,
     ring_mode: str = "auto",
     exchange: str = "dense",
+    async_k: int = 2,
 ) -> CampaignResult:
     """Seed-ensemble random-partner campaign over the factorized mesh:
     the campaign counterpart of `run_sharded_partnered_sim`, replica
     seeds riding the replica axis as traced partner-pick seeds (the
     counter-based hash takes the seed as data, so one compiled program
     serves every seed). Replica r is bitwise its solo partnered run with
-    ``seed=replicas.seeds[r]``."""
+    ``seed=replicas.seeds[r]``, including under the async exchange
+    spellings (``exchange``/``async_k`` follow
+    `run_sharded_partnered_sim`: anti-entropy only, delays clamped
+    host-side to max(d, K))."""
     from p2p_gossip_tpu.parallel import exchange as exch_mod
     from p2p_gossip_tpu.parallel.engine_sharded import (
         _padded_device_graph,
@@ -408,6 +434,16 @@ def run_sharded_protocol_campaign(
 
     if protocol not in ("pushpull", "pull", "pushk"):
         raise ValueError(f"unknown protocol {protocol!r}")
+    transport, k_async = async_ticks.parse_exchange(exchange, async_k)
+    exchange = transport
+    if k_async:
+        if protocol == "pushk":
+            raise ValueError(
+                "async exchange needs an anti-entropy protocol "
+                "(pushpull/pull): fanout push exchanges same-round "
+                "digests — there is nothing to overlap"
+            )
+        ring_mode = "sharded"
     replica_shards, n_node_shards = _campaign_mesh_dims(mesh)
     r_total = replicas.num_replicas
     s = replicas.shares_per_replica
@@ -427,6 +463,16 @@ def run_sharded_protocol_campaign(
         uniform_placeholder=False, with_mask=False,
     )
     n_padded = ell_idx.shape[0]
+    if k_async:
+        # Clamp BEFORE the distinct-delay set / ring sizing, exactly as
+        # run_sharded_partnered_sim does.
+        stale_values, stale_amounts = async_ticks.protocol_staleness_amounts(
+            ell_delay, k_async
+        )
+        ell_delay = async_ticks.clamp_partner_delays(ell_delay, k_async)
+        ring = async_ticks.effective_ring(ring, k_async)
+    else:
+        stale_values, stale_amounts = (), ()
 
     # Ring + exchange resolution mirrors run_sharded_partnered_sim.
     if exchange not in ("dense", "delta", "auto"):
@@ -478,6 +524,17 @@ def run_sharded_protocol_campaign(
                 capacity=capacity,
             )
         )
+    if k_async:
+        exchange_extra.update(async_ticks.modeled_overlap_report(
+            "delta" if delta_on else "dense",
+            delay_values, k_async, n_node_shards, n_loc, w, capacity,
+        ))
+        exchange_extra["staleness_amounts"] = list(stale_amounts)
+    amounts_by_value = dict(zip(stale_values, stale_amounts))
+    async_staleness = (
+        tuple(amounts_by_value.get(v, 0) for v in delay_values)
+        if k_async else ()
+    )
 
     loss_cfg, lseed_arr = _resolve_loss(loss, loss_seeds, r_total)
     static_loss, lseed_arr = _campaign_loss_seeds(loss_cfg, lseed_arr, r_total)
@@ -492,6 +549,7 @@ def run_sharded_protocol_campaign(
         delta_capacity=capacity,
         replica_axis=REPLICAS_AXIS, local_replicas=rb,
         per_replica_loss=(loss is not None),
+        async_k=k_async, async_staleness=async_staleness,
     )
 
     received = np.zeros((r_total, n_padded), dtype=np.int64)
@@ -516,6 +574,9 @@ def run_sharded_protocol_campaign(
             batch_size,
             ell_delays if ell_delays is not None else constant_delay,
             ring_mode, exchange, int(record_coverage),
+            # The fingerprint hashes the USER delay array (pre-clamp),
+            # so the async clamp must be marked explicitly.
+            *(["async", k_async] if k_async else []),
             replicas.churn[0] if replicas.churn is not None else None,
             replicas.churn[1] if replicas.churn is not None else None,
             *(["loss", static_loss[0]] if static_loss else []),

@@ -33,6 +33,18 @@ instead of N — with a mesh-uniform dense fallback per overflowed ring
 slot. Bitwise-identical counters on every path; modeled and achieved
 wire words are reported in ``stats.extra['exchange']``.
 
+``exchange="async"`` (bounded-staleness async ticks,
+parallel/async_ticks.py) removes the read-side exchange barrier on
+either transport: each shard carries a ``landed`` double-buffer — the
+completed gather of an older ring slot, issued a full tick before its
+first reader — and runs up to K ticks ahead on locally-known bits while
+the next gather completes in the background. Results are bitwise
+identical, per tick, to the synchronous engine run with cross-shard
+edge delays clamped to ``max(d, K)`` (intra-shard edges stay timely);
+K=1 is the synchronous program itself. See the async_ticks module
+docstring for the exact-semantics contract and the OR-monotonicity
+safety argument.
+
 Single-device equivalence is bitwise for BOTH layouts: the tick body ORs
 the same edge set in either decomposition, and the tests assert identical
 per-node counters against `engine.sync` and `engine.event` across mesh
@@ -71,6 +83,7 @@ from p2p_gossip_tpu.ops.ell import (
     split_ell_by_delay,
     tuned_degree_block,
 )
+from p2p_gossip_tpu.parallel import async_ticks
 from p2p_gossip_tpu.parallel.mesh import NODES_AXIS, SHARES_AXIS, pad_to_multiple
 from p2p_gossip_tpu import telemetry
 from p2p_gossip_tpu.telemetry import digest as tel_digest
@@ -447,6 +460,7 @@ def build_sharded_runner(
     replica_axis: str | None = None,
     local_replicas: int = 1,
     per_replica_loss: bool = False,
+    async_k: int = 0,
 ):
     """Compile the per-pass runner: each shares-shard processes its own
     ``chunk_size`` shares over the row-sharded graph, from the chunk's first
@@ -512,7 +526,23 @@ def build_sharded_runner(
     returns one extra trailing output: a per-share-shard (8,) uint32
     counter row [used_entries_lo, used_entries_hi, overflow_write_ticks,
     dense_fallback_reads, exchange_ticks, 0, 0, 0] for achieved-traffic
-    accounting (host side: `stats.extra['exchange']`)."""
+    accounting (host side: `stats.extra['exchange']`).
+
+    ``async_k`` > 0 (sharded ring only) switches the read side to the
+    bounded-staleness async path (module docstring,
+    parallel/async_ticks.py): a ``landed`` carry holds one prefetched
+    full-canvas slice per distinct offset ``off = max(d, K)``, issued at
+    the top of the PREVIOUS tick from pre-write ring state (slot
+    ``t - off`` is final and is never this tick's write slot, so the
+    value equals a read-time gather — the restructure only moves the
+    collective a full tick ahead of its first reader, which is what
+    lets XLA overlap it with the whole tick's compute). Reads overlay
+    the shard's own timely ``(t - d)`` slice onto the landed canvas, so
+    intra-shard edges see delay d and cross-shard edges ``max(d, K)``
+    automatically. The quiescence predicate ORs the landed carry in
+    (`async_ticks.in_flight`) so termination is agreed at a common fold
+    epoch. Works on both transports; requires
+    ``ring_size >= max(dmax, K) + 1`` (`async_ticks.effective_ring`)."""
     campaign = replica_axis is not None
     if campaign:
         if local_replicas < 1:
@@ -552,6 +582,29 @@ def build_sharded_runner(
     n_groups = (
         1 if uniform_delay is not None
         else (len(delay_values) if delay_values else 1)
+    )
+    group_delays_s = (
+        (uniform_delay,) if uniform_delay is not None else delay_values
+    )
+    if async_k > 0:
+        if not sharded_ring:
+            raise ValueError("async exchange requires ring_mode='sharded'")
+        offs, off_index, amounts = async_ticks.group_offsets(
+            group_delays_s, async_k
+        )
+        if offs and ring_size < max(offs) + 1:
+            raise ValueError(
+                f"async_k={async_k} needs ring_size >= {max(offs) + 1} "
+                f"(async_ticks.effective_ring), got {ring_size}"
+            )
+    else:
+        offs, off_index, amounts = (), (), ()
+    n_offs = len(offs)
+    # Dense read-time gather count per tick: one per landed slice plus
+    # one per direct-read group (off == 1: K=1 delay-1 edges).
+    n_dense_reads = (
+        n_offs + sum(1 for i in off_index if i < 0) if async_k > 0
+        else n_groups
     )
 
     def local_coverage(seen):
@@ -626,6 +679,18 @@ def build_sharded_runner(
                 #  exchange_ticks, 0, 0, 0]
                 jnp.zeros((8,), dtype=jnp.uint32),
             )
+        landed_i = (
+            7 + (1 if tel else 0) + (1 if dig else 0) + (4 if delta else 0)
+        )
+        if n_offs:
+            # Async landed double-buffer: one prefetched full-canvas
+            # slice per distinct offset, holding the completed gather of
+            # ring slot (t - off) at the top of tick t. Zeros are exact
+            # for any t_start: every pass starts from a zeroed ring, so
+            # the slots those gathers would have read are all-zero.
+            rstate = rstate + (
+                jnp.zeros((n_offs, n_padded, w), dtype=jnp.uint32),
+            )
         if campaign:
             # One state copy per local replica: the tick step is vmapped
             # over this leading rb axis inside the shared while_loop.
@@ -641,8 +706,12 @@ def build_sharded_runner(
             # OR-reduce makes the predicate uniform either way. In
             # campaign mode the loop runs until the SLOWEST replica on
             # the mesh quiesces (extra ticks are exact identities for
-            # the already-quiet replicas, see build docstring).
-            in_flight = jnp.any(hist != 0)
+            # the already-quiet replicas, see build docstring). Async
+            # runs OR the landed carry in: quiescence is agreed only at
+            # a common fold epoch (async_ticks.in_flight).
+            in_flight = async_ticks.in_flight(
+                hist, state[1 + landed_i] if n_offs else None
+            )
             in_flight = lax.psum(
                 in_flight.astype(jnp.int32), (axis0, NODES_AXIS)
             ) > 0
@@ -680,7 +749,46 @@ def build_sharded_runner(
             return lax.cond(dflag_ring[slot], dense_read, delta_read,
                             operand=None)
 
-        def arrivals_for(hist, dstate, t, loss_cfg=loss, lseed=None):
+        def prefetch_landed(hist, dstate, t):
+            """The async gathers for tick t+1's reads, issued at the top
+            of tick t from PRE-write ring state: slot (t+1-off) was
+            written at tick t+1-off <= t-1 (off >= 2) and is never this
+            tick's write slot (2 <= off < ring_size), so each slice
+            equals the read-time gather read_slice would have done — the
+            restructure only moves the collective a full tick ahead of
+            its first reader. On the delta transport the slot's
+            overflow flag routes to the dense gather exactly like
+            read_slice; the scatter canvas leaves own rows zero (they
+            never ride the wire) and the dense branch's own rows are
+            stale — both fine, the reader overlays its timely local
+            slice either way."""
+            slices = []
+            for off in offs:
+                slot_u = jnp.mod(t + 1 - off, ring_size)
+                sl = hist[slot_u]
+                if not delta:
+                    slices.append(
+                        lax.all_gather(sl, NODES_AXIS, axis=0, tiled=True)
+                    )
+                    continue
+                didx_ring, dval_ring, dflag_ring = dstate
+
+                def dense_pre(_, sl=sl):
+                    return lax.all_gather(sl, NODES_AXIS, axis=0, tiled=True)
+
+                def delta_pre(_, slot_u=slot_u):
+                    return exch.scatter_deltas(
+                        didx_ring[slot_u], dval_ring[slot_u], n_loc, w,
+                        n_padded,
+                    )
+
+                slices.append(lax.cond(
+                    dflag_ring[slot_u], dense_pre, delta_pre, operand=None
+                ))
+            return jnp.stack(slices)
+
+        def arrivals_for(hist, dstate, t, loss_cfg=loss, lseed=None,
+                         landed=None):
             # One gather group per delay value (one group total under a
             # uniform delay); read_slice resolves local vs all_gathered
             # per ring layout. Within a group, the degree buckets
@@ -692,11 +800,11 @@ def build_sharded_runner(
             # the OR over groups equals the full-ELL gather.
             # ``loss_cfg`` defaults to the compiled loss model; the
             # telemetry row prices loss_dropped by re-gathering with
-            # loss_cfg=None (telemetry-on only).
-            group_delays = (
-                (uniform_delay,) if uniform_delay is not None
-                else delay_values
-            )
+            # loss_cfg=None (telemetry-on only). Async groups read the
+            # prefetched landed canvas (slot t - max(d, K)) with the
+            # shard's own timely (t - d) slice overlaid, so intra-shard
+            # edges see delay d and cross-shard edges max(d, K).
+            group_delays = group_delays_s
             def loss_dst_ids(local_rows):
                 # THE global-id convention the loss coin hashes (shared
                 # with the single-device engines): shard row offset +
@@ -708,7 +816,14 @@ def build_sharded_runner(
             acc = jnp.zeros((n_loc, w), dtype=jnp.uint32)
             pos = 0
             for gi, dval in enumerate(group_delays):
-                sl = read_slice(hist, dstate, t, dval)
+                if n_offs and off_index[gi] >= 0:
+                    sl = lax.dynamic_update_slice(
+                        landed[off_index[gi]],
+                        hist[jnp.mod(t - dval, ring_size)],
+                        (row_offset, 0),
+                    )
+                else:
+                    sl = read_slice(hist, dstate, t, dval)
                 if bucket_counts[gi] == 0:
                     # Direct full-width pair (uniform-degree group —
                     # bucketing would save <25%, see _stage_ell_args):
@@ -755,31 +870,44 @@ def build_sharded_runner(
             # campaign vmap shares it). All collectives inside address
             # NODES_AXIS only, so the vmap batches them per replica.
             seen, hist, received, sent, snaps, cov_run, cov_hist = rstate[:7]
+            landed = rstate[landed_i] if n_offs else None
             if delta:
                 didx_ring, dval_ring, dflag_ring, ectr = rstate[ex_i:ex_i + 4]
                 dstate = (didx_ring, dval_ring, dflag_ring)
-                # Dense fallbacks this tick: one per delay group whose
-                # read slot carries the (mesh-uniform) overflow flag.
+                # Dense fallbacks this tick: one per read slot carrying
+                # the (mesh-uniform) overflow flag — per landed offset
+                # plus per direct-read group under async, per delay
+                # group otherwise.
+                read_backs = (
+                    offs + tuple(
+                        dv for gi, dv in enumerate(group_delays_s)
+                        if off_index[gi] < 0
+                    )
+                    if n_offs else group_delays_s
+                )
                 fb_t = jnp.zeros((), dtype=jnp.uint32)
-                for dv in (
-                    (uniform_delay,) if uniform_delay is not None
-                    else delay_values
-                ):
+                for dv in read_backs:
                     fb_t = fb_t + dflag_ring[
                         jnp.mod(t - dv, ring_size)
                     ].astype(jnp.uint32)
             else:
                 dstate = None
+            if n_offs:
+                # Issue tick t+1's gathers FIRST — no dependency on this
+                # tick's compute or writes, so the collective can ride
+                # the whole tick in the background.
+                landed_next = prefetch_landed(hist, dstate, t)
             if num_snaps:
                 snaps = jnp.where(
                     (snap_ticks == t)[:, None], received[None, :], snaps
                 )
-            arrivals = arrivals_for(hist, dstate, t, lseed=lseed)
+            arrivals = arrivals_for(hist, dstate, t, lseed=lseed,
+                                    landed=landed)
             if tel:
                 received_in = received
                 arrivals_raw = arrivals  # post-loss, pre-churn wire view
                 arrivals_nl = (
-                    arrivals_for(hist, dstate, t, None)
+                    arrivals_for(hist, dstate, t, None, landed=landed)
                     if loss is not None else None
                 )
             up = up_mask_jnp(churn_start_r, churn_end_r, t)
@@ -886,10 +1014,33 @@ def build_sharded_runner(
                     )
                 elif sharded_ring:
                     ex_words = jnp.uint32(
-                        n_groups * (n_node_shards - 1) * n_loc * w
+                        n_dense_reads * (n_node_shards - 1) * n_loc * w
                     )
                 else:
                     ex_words = jnp.uint32((n_node_shards - 1) * n_loc * w)
+                # Async staleness accounting: each group running
+                # off = max(d, K) > d late charges its (off - d) amount
+                # on ticks where its remote (cross-shard) view held any
+                # pending bit. Same canvas on every shard, so the NODES
+                # psum below scales both columns by n_node_shards — the
+                # schema documents the columns as summed over node
+                # shards, like the rest of the row.
+                stale_t = jnp.uint32(0)
+                folds_t = jnp.uint32(0)
+                if n_offs and any(a > 0 for a in amounts):
+                    remote_row = (
+                        jnp.arange(n_padded, dtype=jnp.int32) // n_loc
+                        != lax.axis_index(NODES_AXIS).astype(jnp.int32)
+                    )
+                    for gi, amt in enumerate(amounts):
+                        if amt <= 0:
+                            continue
+                        pending = jnp.any(jnp.where(
+                            remote_row[:, None],
+                            landed[off_index[gi]], jnp.uint32(0),
+                        ) != 0).astype(jnp.uint32)
+                        stale_t = stale_t + jnp.uint32(amt) * pending
+                        folds_t = folds_t + pending
                 # Local row, psum'ed over node shards only: this shard's
                 # ring describes its own share chunk system-wide.
                 met_row = lax.psum(
@@ -897,6 +1048,7 @@ def build_sharded_runner(
                         arrivals_raw, newly_out, received - received_in,
                         degree, arrivals_lossless=arrivals_nl,
                         exchange_words=ex_words,
+                        staleness=stale_t, stale_folds=folds_t,
                     ),
                     NODES_AXIS,
                 )
@@ -913,6 +1065,8 @@ def build_sharded_runner(
                 out = out + (tel_digest.write(rstate[dig_i], t, dval),)
             if delta:
                 out = out + (didx_ring, dval_ring, dflag_ring, ectr)
+            if n_offs:
+                out = out + (landed_next,)
             return out
 
         if campaign:
@@ -1090,7 +1244,7 @@ def _audit_campaign_mesh():
 
 def _audit_spec_flood_runner(
     telemetry_on: bool = False, exchange: str = "dense",
-    campaign: bool = False,
+    campaign: bool = False, async_k: int = 0,
 ):
     """Stage + compile-build the sharded flood runner on tiny shapes and
     hand the auditor the exact mapped callable the production driver
@@ -1099,7 +1253,9 @@ def _audit_spec_flood_runner(
     trace, so the dense fallback is covered too). ``campaign`` audits
     the replica-factorized mode (vmapped tick over the replica batch on
     a (replicas, nodes) mesh) — the jit surface
-    batch/campaign_sharded.py dispatches."""
+    batch/campaign_sharded.py dispatches. ``async_k`` > 0 audits the
+    bounded-staleness landed-carry prefetch path (K-ahead reads on
+    either transport, parallel/async_ticks.py)."""
     from p2p_gossip_tpu.models.topology import erdos_renyi
     from p2p_gossip_tpu.staticcheck.registry import AuditSpec
     from p2p_gossip_tpu.telemetry.schema import NUM_METRICS
@@ -1118,9 +1274,11 @@ def _audit_spec_flood_runner(
      churn_start, churn_end) = _stage_sharded_inputs(
         graph, None, 1, mesh, None, None
     )
+    ring = async_ticks.effective_ring(ring, async_k)
     (ring_mode, ell_args, delay_values, bucket_counts, _extra,
      exchange_plan) = _resolve_and_stage_ring(
-        "auto", uniform, ring, n_padded, mesh.shape[NODES_AXIS],
+        "sharded" if async_k else "auto", uniform, ring, n_padded,
+        mesh.shape[NODES_AXIS],
         bitmask.num_words(chunk), ell_idx, ell_delay, ell_mask, block=block,
         exchange=exchange,
     )
@@ -1132,6 +1290,7 @@ def _audit_spec_flood_runner(
         exchange_mode=exchange_mode, delta_capacity=capacity,
         replica_axis=(REPLICAS_AXIS if campaign else None),
         local_replicas=(local_replicas if campaign else 1),
+        async_k=async_k,
     )
     if campaign:
         origins = np.zeros((r_batch, pass_size), dtype=np.int32)
@@ -1186,6 +1345,14 @@ register_entry(
     "parallel.engine_sharded.flood_runner[campaign-delta]",
     spec=lambda: _audit_spec_flood_runner(exchange="delta", campaign=True),
 )
+register_entry(
+    "parallel.engine_sharded.flood_runner[async]",
+    spec=lambda: _audit_spec_flood_runner(async_k=2),
+)
+register_entry(
+    "parallel.engine_sharded.flood_runner[async-delta]",
+    spec=lambda: _audit_spec_flood_runner(exchange="delta", async_k=2),
+)
 
 
 def run_sharded_sim(
@@ -1207,6 +1374,7 @@ def run_sharded_sim(
     connect_tick: int = 0,
     bucket_min_rows: int = 2048,
     exchange: str = "dense",
+    async_k: int = 2,
 ) -> NodeStats:
     """Drop-in counterpart of run_sync_sim/run_event_sim on a device mesh:
     identical per-node counters, any number of shares — including under a
@@ -1241,14 +1409,32 @@ def run_sharded_sim(
     bitwise-identical counters), or "auto" (delta whenever the ring is
     sharded across >1 node shards). The resolved path, its modeled
     per-tick traffic, and the achieved counters land in
-    ``stats.extra['exchange']``."""
+    ``stats.extra['exchange']``.
+
+    ``exchange`` "async" / "async-dense" / "async-delta" switch to the
+    bounded-staleness async read path with ``async_k`` = K (module and
+    `parallel/async_ticks.py` docstrings): the engine runs up to K
+    ticks ahead on locally-known bits over a prefetched landed
+    double-buffer, bitwise-equal per tick to the synchronous run with
+    cross-shard edge delays clamped to ``max(d, K)``
+    (`async_ticks.clamp_flood_delays` builds that reference). "async"
+    resolves the transport like "auto"; the ring is forced sharded and
+    grows to ``max(dmax, K) + 1`` slots. ``async_k`` is ignored on the
+    synchronous modes. Because K >= 2 changes results (by design —
+    staleness trades ticks for overlap), the checkpoint fingerprint
+    includes it."""
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
+    transport, k_async = async_ticks.parse_exchange(exchange, async_k)
+    exchange = transport
+    if k_async:
+        ring_mode = "sharded"
     (ell_idx, ell_delay, ell_mask, degree, ring, uniform, n_padded, block,
      churn_start, churn_end) = _stage_sharded_inputs(
         graph, ell_delays, constant_delay, mesh, block, churn
     )
     boundaries = filter_snapshot_boundaries(snapshot_ticks, horizon_ticks)
     snap_ticks_arr = np.asarray(boundaries, dtype=np.int32)
+    ring = async_ticks.effective_ring(ring, k_async)
     (ring_mode, ell_args, delay_values, bucket_counts, ring_extra,
      exchange_plan) = _resolve_and_stage_ring(
         ring_mode, uniform, ring, n_padded, mesh.shape[NODES_AXIS],
@@ -1257,6 +1443,14 @@ def run_sharded_sim(
     )
     exchange_mode, need, capacity, exchange_extra = exchange_plan
     delta_on = exchange_mode == "delta"
+    if k_async:
+        exchange_extra.update(async_ticks.modeled_overlap_report(
+            exchange_mode,
+            (uniform,) if uniform is not None else delay_values,
+            k_async, mesh.shape[NODES_AXIS],
+            n_padded // mesh.shape[NODES_AXIS],
+            bitmask.num_words(chunk_size), capacity,
+        ))
     tel = telemetry.rings_enabled()
     runner, pass_size = build_sharded_runner(
         mesh, n_padded, ring, chunk_size, horizon_ticks, block, uniform,
@@ -1265,7 +1459,7 @@ def run_sharded_sim(
         ring_mode=ring_mode, delay_values=delay_values,
         connect_tick=connect_tick, bucket_counts=bucket_counts,
         telemetry_on=tel, exchange_mode=exchange_mode,
-        delta_capacity=capacity,
+        delta_capacity=capacity, async_k=k_async,
     )
     n_share_shards = mesh.shape[SHARES_AXIS]
     exch_counters = np.zeros(3, dtype=np.int64)  # used, ovf, fallback
@@ -1299,6 +1493,10 @@ def run_sharded_sim(
             *([np.asarray(boundaries, dtype=np.int64)] if boundaries else []),
             # Warm-up window changes the results; appended only when on.
             *(["connect", connect_tick] if connect_tick else []),
+            # Async K >= 2 changes results (bounded staleness on
+            # cross-shard edges); appended only when on so synchronous
+            # fingerprints stay byte-stable across this change.
+            *(["async", k_async] if k_async else []),
         )
         checkpointer = ChunkCheckpointer(
             checkpoint_path, ckpt_fp,
@@ -1412,13 +1610,17 @@ def run_sharded_flood_coverage(
     ring_mode: str = "auto",
     bucket_min_rows: int = 2048,
     exchange: str = "dense",
+    async_k: int = 2,
 ):
     """Flood coverage-time experiment on the device mesh — the BASELINE
     north-star metric (time-to-99% coverage at 1M nodes on a v5e-8 mesh)
     with the same contract as `engine.sync.run_flood_coverage`: one share
     per origin at t=0, returns (stats, (horizon, num_origins) per-tick node
     counts). Coverage values are identical to the single-device run for
-    every mesh shape (the per-tick count psums over node shards)."""
+    every mesh shape (the per-tick count psums over node shards).
+    ``exchange``/``async_k`` take the same values as `run_sharded_sim`,
+    including the async spellings — the coverage matrix is what the
+    `async_ticks.ttc_percentiles` staleness probe bounds."""
     origins = np.asarray(origins, dtype=np.int32).reshape(-1)
     s = origins.shape[0]
     n_share_shards = mesh.shape[SHARES_AXIS]
@@ -1430,11 +1632,16 @@ def run_sharded_flood_coverage(
     # dead padding every tick would cost up to chunk_size/s extra work.
     cov_slots = bitmask.num_words(min(s, chunk_size)) * bitmask.WORD_BITS
     sched = Schedule(graph.n, origins, np.zeros(s, dtype=np.int32))
+    transport, k_async = async_ticks.parse_exchange(exchange, async_k)
+    exchange = transport
+    if k_async:
+        ring_mode = "sharded"
 
     (ell_idx, ell_delay, ell_mask, degree, ring, uniform, n_padded, block,
      churn_start, churn_end) = _stage_sharded_inputs(
         graph, ell_delays, constant_delay, mesh, block, churn
     )
+    ring = async_ticks.effective_ring(ring, k_async)
     (ring_mode, ell_args, delay_values, bucket_counts, ring_extra,
      exchange_plan) = _resolve_and_stage_ring(
         ring_mode, uniform, ring, n_padded, mesh.shape[NODES_AXIS],
@@ -1443,6 +1650,14 @@ def run_sharded_flood_coverage(
     )
     exchange_mode, need, capacity, exchange_extra = exchange_plan
     delta_on = exchange_mode == "delta"
+    if k_async:
+        exchange_extra.update(async_ticks.modeled_overlap_report(
+            exchange_mode,
+            (uniform,) if uniform is not None else delay_values,
+            k_async, mesh.shape[NODES_AXIS],
+            n_padded // mesh.shape[NODES_AXIS],
+            bitmask.num_words(chunk_size), capacity,
+        ))
     _rss_log("ring staged")
     tel = telemetry.rings_enabled()
     runner, pass_size = build_sharded_runner(
@@ -1451,6 +1666,7 @@ def run_sharded_flood_coverage(
         ring_mode=ring_mode, delay_values=delay_values,
         bucket_counts=bucket_counts, telemetry_on=tel,
         exchange_mode=exchange_mode, delta_capacity=capacity,
+        async_k=k_async,
     )
     o, g_ticks = sched.padded(pass_size, horizon_ticks)
     _rss_log("runner built")

@@ -132,6 +132,7 @@ def compress_deltas(
     changed: jnp.ndarray,   # (n_loc, w) uint32 — this tick's delta words
     need: jnp.ndarray,      # (n_loc, n_dests) bool — cut membership
     capacity: int,
+    aggregate: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Pack nonzero words into per-destination fixed-capacity buffers.
 
@@ -141,7 +142,15 @@ def compress_deltas(
     means the buffer truncated and the caller must flag overflow).
     Static shapes only: candidates are ranked by cumsum; rank >=
     capacity (and every non-candidate) writes a trailing trash slot that
-    is trimmed away."""
+    is trimmed away.
+
+    ``aggregate=True`` pre-buckets destination-major: the per-dest
+    buffers become one flat (n_dests * (capacity + 1),) aggregate and
+    the two 2-D dual-index scatters collapse into single 1-D scatters at
+    global slots ``d * (capacity + 1) + slot`` — same candidate ranking,
+    same trash-slot spill per destination, bitwise-identical
+    (idx, val, counts) (tests/test_exchange.py pins this), one scatter
+    dimension for the compiler instead of two."""
     n_loc, w = changed.shape
     n_dests = need.shape[1]
     flat = changed.reshape(n_loc * w)
@@ -150,17 +159,41 @@ def compress_deltas(
     cand = (flat != 0)[None, :] & jnp.repeat(need.T, w, axis=1)
     rank = jnp.cumsum(cand.astype(jnp.int32), axis=1) - 1
     slot = jnp.where(cand & (rank < capacity), rank, capacity)
-    d_ids = jnp.arange(n_dests, dtype=jnp.int32)[:, None]
     ids = jnp.arange(n_loc * w, dtype=jnp.int32)[None, :]
-    idx = (
-        jnp.full((n_dests, capacity + 1), -1, dtype=jnp.int32)
-        .at[d_ids, slot].set(jnp.broadcast_to(ids, slot.shape))[:, :capacity]
-    )
-    val = (
-        jnp.zeros((n_dests, capacity + 1), dtype=jnp.uint32)
-        .at[d_ids, slot].set(jnp.broadcast_to(flat[None, :], slot.shape))
-        [:, :capacity]
-    )
+    if aggregate:
+        # Destination-major aggregate: dest d owns the flat slot block
+        # [d * (capacity + 1), (d + 1) * (capacity + 1)); every kept
+        # slot is written exactly once (ranks are unique per dest), the
+        # per-dest trash slot absorbs the spill, and the reshape + trim
+        # recovers the per-destination layout bit-for-bit.
+        stride = capacity + 1
+        gslot = (
+            slot + jnp.arange(n_dests, dtype=jnp.int32)[:, None] * stride
+        ).reshape(-1)
+        idx = (
+            jnp.full((n_dests * stride,), -1, dtype=jnp.int32)
+            .at[gslot].set(jnp.broadcast_to(ids, slot.shape).reshape(-1))
+            .reshape(n_dests, stride)[:, :capacity]
+        )
+        val = (
+            jnp.zeros((n_dests * stride,), dtype=jnp.uint32)
+            .at[gslot].set(
+                jnp.broadcast_to(flat[None, :], slot.shape).reshape(-1)
+            )
+            .reshape(n_dests, stride)[:, :capacity]
+        )
+    else:
+        d_ids = jnp.arange(n_dests, dtype=jnp.int32)[:, None]
+        idx = (
+            jnp.full((n_dests, capacity + 1), -1, dtype=jnp.int32)
+            .at[d_ids, slot].set(jnp.broadcast_to(ids, slot.shape))
+            [:, :capacity]
+        )
+        val = (
+            jnp.zeros((n_dests, capacity + 1), dtype=jnp.uint32)
+            .at[d_ids, slot].set(jnp.broadcast_to(flat[None, :], slot.shape))
+            [:, :capacity]
+        )
     counts = jnp.sum(cand.astype(jnp.int32), axis=1)
     return idx, val, counts
 
@@ -204,10 +237,11 @@ def _audit_spec(kind: str):
         rng.integers(0, 1 << 32, (n_loc, w), dtype=np.uint64),
         dtype=jnp.uint32,
     )
-    if kind == "compress":
+    if kind in ("compress", "compress-aggregate"):
         need = jnp.asarray(rng.random((n_loc, shards)) < 0.5)
+        agg = kind == "compress-aggregate"
         return AuditSpec(
-            fn=lambda ch, nd: compress_deltas(ch, nd, cap),
+            fn=lambda ch, nd: compress_deltas(ch, nd, cap, aggregate=agg),
             args=(changed, need),
             integer_only=True,
             bitmask_words=(w, cap),
@@ -233,6 +267,10 @@ from p2p_gossip_tpu.staticcheck.registry import register_entry  # noqa: E402
 register_entry(
     "parallel.exchange.compress_deltas[delta]",
     spec=lambda: _audit_spec("compress"),
+)
+register_entry(
+    "parallel.exchange.compress_deltas[aggregate]",
+    spec=lambda: _audit_spec("compress-aggregate"),
 )
 register_entry(
     "parallel.exchange.scatter_deltas[delta]",

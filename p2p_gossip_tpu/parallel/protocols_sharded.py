@@ -50,6 +50,22 @@ advance dense-resets from a full slice all_gather (the hist slot IS the
 cumulative slice, so the reset is exact). Bitwise-identical counters on
 every path; fanout push's sharded ring reads no remote state at all
 ("none" — nothing to compress).
+
+``exchange="async"`` (bounded-staleness async ticks,
+parallel/async_ticks.py) removes the read-side exchange barrier for the
+anti-entropy protocols: partners are global-random — no locality to
+preserve — so async(K) is the same protocol with every partner-read
+delay clamped host-side to ``max(d, K)`` (`clamp_partner_delays`,
+applied by the driver BEFORE staging so the compiled runner, the
+checkpoint fingerprint, and the synchronous parity reference all see
+the same delays). With every read then >= K ticks deep, the exchange
+collective for round t+1's reads can be issued at the END of round t —
+a full round before its first reader. The delta path's per-delay
+mirrors already ARE that double-buffer (the mirror advance touches only
+slots finalized this round or earlier); the dense path grows a
+``landed`` carry of prefetched (t - d) global slices that replaces the
+read-time per-delay all_gathers, advanced the same way. ``pushk``
+pushes same-round digests — there is nothing to overlap — and raises.
 """
 
 from __future__ import annotations
@@ -63,6 +79,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from p2p_gossip_tpu.parallel import async_ticks
 from p2p_gossip_tpu.parallel.mesh import shard_map
 
 from p2p_gossip_tpu.engine.sync import MIN_CHUNK_SHARES
@@ -113,6 +130,8 @@ def build_partnered_runner(
     replica_axis: str | None = None,
     local_replicas: int = 1,
     per_replica_loss: bool = False,
+    async_k: int = 0,
+    async_staleness: tuple = (),
 ):
     """Compile the per-pass runner for a random-partner protocol over the
     mesh. Memoized on mesh/shapes like engine_sharded.build_sharded_runner.
@@ -147,7 +166,20 @@ def build_partnered_runner(
     advanced mirrors of the delayed global slices — bitwise-identical
     counters, one extra trailing (1, 8) uint32 counter output
     [used_entries_lo, used_entries_hi, overflow_write_ticks,
-    dense_fallback_reads, exchange_ticks, 0, 0, 0] per share-shard."""
+    dense_fallback_reads, exchange_ticks, 0, 0, 0] per share-shard.
+
+    ``async_k`` > 0 (sharded ring, anti-entropy only — the driver feeds
+    delays already clamped to >= K via `clamp_partner_delays`) enables
+    the bounded-staleness async read side (module docstring): on the
+    dense transport a ``landed`` carry of prefetched (t - d) global
+    slices replaces the read-time all_gathers, advanced at the end of
+    each round from the just-written ring (exact for every d >= 1 —
+    slot t + 1 - d is final once round t's write lands); the delta
+    mirrors need no restructuring. ``async_staleness`` pairs each
+    ``delay_values`` entry with its added-lateness amount
+    (`protocol_staleness_amounts` — the builder only sees clamped
+    delays, so the pre-clamp bookkeeping must ride in) for the
+    ``staleness``/``stale_folds`` telemetry columns."""
     if protocol not in ("pushpull", "pull", "pushk"):
         raise ValueError(f"unknown protocol {protocol!r}")
     if fanout < 1:
@@ -192,6 +224,21 @@ def build_partnered_runner(
         raise ValueError("exchange_mode='delta' needs ring_size >= 2")
     if delta:
         from p2p_gossip_tpu.parallel import exchange as exch
+    if async_k > 0:
+        if not (sharded_ring and anti):
+            raise ValueError(
+                "async_k > 0 needs the sharded ring and an anti-entropy "
+                "protocol (fanout push exchanges same-round digests — "
+                "nothing to overlap; parallel/async_ticks.py)"
+            )
+        if not delay_values or len(async_staleness) != len(delay_values):
+            raise ValueError(
+                "async_k > 0 needs delay_values and a matching "
+                "async_staleness tuple (one amount per distinct delay)"
+            )
+    # Dense transport under async: the landed double-buffer replaces the
+    # read-time slice all_gathers (the delta mirrors already are one).
+    landed_on = async_k > 0 and not delta
     n_groups = len(delay_values) if delay_values else 1
 
     def pass_fn(
@@ -259,6 +306,19 @@ def build_partnered_runner(
                 #  exchange_ticks, 0, 0, 0]
                 jnp.zeros((8,), dtype=jnp.uint32),
             )
+        landed_i = (
+            6 + (1 if tel else 0) + (1 if dig else 0)
+            + (5 if delta else 0)
+        )
+        if landed_on:
+            # Async landed double-buffer: one prefetched global (t - d)
+            # seen-slice per distinct delay. Zeros-init is exact — at
+            # t=0 every read targets pre-history (all-zero) slices.
+            state = state + (
+                jnp.zeros(
+                    (len(delay_values), n_padded, w), dtype=jnp.uint32
+                ),
+            )
         if campaign:
             # One state copy per local replica: the round step is
             # vmapped over this leading rb axis inside the fori_loop.
@@ -275,6 +335,10 @@ def build_partnered_runner(
             if delta:
                 (mirrors, didx_ring, dval_ring, dflag_ring,
                  ectr) = rstate[ex_i:ex_i + 5]
+            landed = rstate[landed_i] if landed_on else None
+            # The remote views THIS round folds in (pre-advance) — what
+            # the staleness telemetry charges against.
+            views_in = mirrors if delta else landed  # None unless async
             t = jnp.int32(t)
             if anti:
                 kidx = pick_index_jnp(node_ids, t, 0, degree, seed_r)
@@ -303,6 +367,10 @@ def build_partnered_runner(
                     for j, dval in enumerate(delay_values):
                         if delta:
                             f_d = mirrors[j]
+                        elif landed_on:
+                            # The prefetched slice — its all_gather was
+                            # issued at the end of the PREVIOUS round.
+                            f_d = landed[j]
                         else:
                             f_d = lax.all_gather(
                                 hist[jnp.mod(t - dval, ring_size)],
@@ -503,6 +571,22 @@ def build_partnered_runner(
                     ectr[4] + jnp.uint32(1),
                     ectr[5], ectr[6], ectr[7],
                 ))
+            if landed_on:
+                # Advance the double-buffer to the slices the NEXT round
+                # reads (u = t + 1 - d): one background all_gather per
+                # distinct delay, issued a full round before its first
+                # reader — the read-side barrier the async mode removes.
+                # The post-write ring is exact for every d >= 1: slot u
+                # was finalized by this round's write (d = 1) or an
+                # earlier one, and no later write touches it before the
+                # read (ring_size >= dmax + 1).
+                landed = jnp.stack([
+                    lax.all_gather(
+                        hist[jnp.mod(t + 1 - dv, ring_size)],
+                        NODES_AXIS, axis=0, tiled=True,
+                    )
+                    for dv in delay_values
+                ])
             if record_coverage:
                 cov = lax.psum(
                     bitmask.coverage_per_slot(seen, chunk_size), NODES_AXIS
@@ -528,6 +612,29 @@ def build_partnered_runner(
                     )
                 else:
                     ex_words = jnp.uint32((n_node_shards - 1) * n_loc * w)
+                # Async staleness accounting (schema docstring): each
+                # delay bucket folding remote state later than its
+                # original delay charges its added lateness whenever the
+                # remote (cross-shard) part of the consumed view held
+                # any bit. Static zeros on every sync path.
+                stale_t = jnp.uint32(0)
+                folds_t = jnp.uint32(0)
+                if async_k > 0 and any(a > 0 for a in async_staleness):
+                    remote_row = (
+                        jnp.arange(n_padded, dtype=jnp.int32) // n_loc
+                        != lax.axis_index(NODES_AXIS).astype(jnp.int32)
+                    )
+                    for j, amt in enumerate(async_staleness):
+                        if amt <= 0:
+                            continue
+                        pending = jnp.any(
+                            jnp.where(
+                                remote_row[:, None], views_in[j],
+                                jnp.uint32(0),
+                            ) != 0
+                        ).astype(jnp.uint32)
+                        stale_t = stale_t + jnp.uint32(amt) * pending
+                        folds_t = folds_t + pending
                 pc_newbits = bitmask.popcount_rows(newbits)
                 met_row = lax.psum(
                     tel_rings.row(
@@ -538,6 +645,8 @@ def build_partnered_runner(
                         or_work=tel_rings.u32sum(sent_add),
                         loss_dropped=dropped,
                         exchange_words=ex_words,
+                        staleness=stale_t,
+                        stale_folds=folds_t,
                     ),
                     NODES_AXIS,
                 )
@@ -554,6 +663,8 @@ def build_partnered_runner(
                 out = out + (tel_digest.write(rstate[dig_i], t, dval),)
             if delta:
                 out = out + (mirrors, didx_ring, dval_ring, dflag_ring, ectr)
+            if landed_on:
+                out = out + (landed,)
             return out
 
         if campaign:
@@ -660,7 +771,7 @@ def build_partnered_runner(
 
 def _audit_spec_partnered_runner(
     protocol: str, telemetry_on: bool = False, exchange: str = "dense",
-    campaign: bool = False,
+    campaign: bool = False, async_k: int = 0,
 ):
     """Stage + build the sharded partnered runner on tiny shapes (same
     mesh policy as the flood audit spec). The u64 ``sent`` counter halves
@@ -669,7 +780,9 @@ def _audit_spec_partnered_runner(
     word width. ``exchange`` "delta" audits the sparse seen-delta path
     (sharded ring; both mirror-advance cond branches trace). ``campaign``
     audits the replica-factorized mode on a (replicas, nodes) mesh — the
-    jit surface run_sharded_protocol_campaign dispatches."""
+    jit surface run_sharded_protocol_campaign dispatches. ``async_k``
+    > 0 audits the bounded-staleness landed-carry path on the dense
+    transport (clamped delays, parallel/async_ticks.py)."""
     from p2p_gossip_tpu.models.topology import erdos_renyi
     from p2p_gossip_tpu.parallel.engine_sharded import (
         _audit_campaign_mesh,
@@ -709,6 +822,15 @@ def _audit_spec_partnered_runner(
             delta_capacity=capacity,
             replica_axis=("replicas" if campaign else None),
             local_replicas=(local_replicas if campaign else 1),
+        )
+    elif async_k:
+        ell_delays = async_ticks.clamp_partner_delays(ell_delays, async_k)
+        ring = async_ticks.effective_ring(ring, async_k)
+        runner, pass_size = build_partnered_runner(
+            mesh, protocol, n_padded, ring, chunk, horizon, 1,
+            (1 << 20, 7), False, ring_mode="sharded",
+            delay_values=(max(1, async_k),), telemetry_on=telemetry_on,
+            async_k=async_k, async_staleness=(max(0, async_k - 1),),
         )
     else:
         runner, pass_size = build_partnered_runner(
@@ -780,6 +902,10 @@ register_entry(
     "parallel.protocols_sharded.pushpull_runner[campaign]",
     spec=lambda: _audit_spec_partnered_runner("pushpull", campaign=True),
 )
+register_entry(
+    "parallel.protocols_sharded.pushpull_runner[async]",
+    spec=lambda: _audit_spec_partnered_runner("pushpull", async_k=2),
+)
 
 
 def run_sharded_partnered_sim(
@@ -801,6 +927,7 @@ def run_sharded_partnered_sim(
     stop_after_chunks: int | None = None,
     ring_mode: str = "auto",
     exchange: str = "dense",
+    async_k: int = 2,
 ):
     """Drop-in counterpart of run_pushpull_sim / run_pushk_sim on a device
     mesh: identical per-node counters for any mesh shape (the counter-based
@@ -825,6 +952,17 @@ def run_sharded_partnered_sim(
     reads no remote state on the sharded ring, so "delta" degrades to
     that free path. Resolved mode, modeled traffic, and achieved
     counters land in ``stats.extra['exchange']``.
+
+    "async" / "async-dense" / "async-delta" switch the anti-entropy
+    read side to the bounded-staleness async path with ``async_k`` = K
+    (module and `parallel/async_ticks.py` docstrings): every partner
+    read delay is clamped host-side to ``max(d, K)``
+    (`clamp_partner_delays` — the exact parity reference is the same
+    runner on the pre-clamped delay array), the ring grows to
+    ``max(dmax, K) + 1`` slots, and the exchange collectives are issued
+    a round ahead of their readers. ``async_k`` is ignored on the
+    synchronous spellings. ``pushk`` raises — fanout push exchanges
+    same-round digests, nothing to overlap.
     """
     if protocol not in ("pushpull", "pull", "pushk"):
         raise ValueError(f"unknown protocol {protocol!r}")
@@ -832,6 +970,16 @@ def run_sharded_partnered_sim(
         from p2p_gossip_tpu.models.protocols import _check_pull_credit_bound
 
         _check_pull_credit_bound(graph, chunk_size, schedule)
+    transport, k_async = async_ticks.parse_exchange(exchange, async_k)
+    exchange = transport
+    if k_async:
+        if protocol == "pushk":
+            raise ValueError(
+                "async exchange needs an anti-entropy protocol "
+                "(pushpull/pull): fanout push exchanges same-round "
+                "digests — there is nothing to overlap"
+            )
+        ring_mode = "sharded"
     n_node_shards = mesh.shape[NODES_AXIS]
     chunk_size = min(chunk_size, max(MIN_CHUNK_SHARES, schedule.num_shares))
     chunk_size = bitmask.num_words(chunk_size) * bitmask.WORD_BITS
@@ -844,6 +992,19 @@ def run_sharded_partnered_sim(
     )
     n_padded = ell_idx.shape[0]
     churn_start, churn_end = _padded_churn(churn, n_padded, n_node_shards)
+    if k_async:
+        # The async clamp happens BEFORE everything downstream — the
+        # distinct-delay set, the ring size, the compiled runner, and
+        # the checkpoint fingerprint all see the clamped array, so the
+        # synchronous run on the same clamped delays is the bitwise
+        # parity reference.
+        stale_values, stale_amounts = async_ticks.protocol_staleness_amounts(
+            ell_delays, k_async
+        )
+        ell_delays = async_ticks.clamp_partner_delays(ell_delays, k_async)
+        ring = async_ticks.effective_ring(ring, k_async)
+    else:
+        stale_values, stale_amounts = (), ()
 
     # Ring layout (module docstring). The distinct-delay set comes from
     # the padded ELL delay array — a superset of the valid entries (row
@@ -910,6 +1071,19 @@ def run_sharded_partnered_sim(
                 capacity=capacity,
             )
         )
+    if k_async:
+        exchange_extra.update(async_ticks.modeled_overlap_report(
+            "delta" if delta_on else "dense",
+            delay_values, k_async, n_node_shards, n_loc, w, capacity,
+        ))
+        # group_offsets sees only clamped delays (amounts all 0 there);
+        # the real added-lateness bookkeeping is pre-clamp.
+        exchange_extra["staleness_amounts"] = list(stale_amounts)
+    amounts_by_value = dict(zip(stale_values, stale_amounts))
+    async_staleness = (
+        tuple(amounts_by_value.get(v, 0) for v in delay_values)
+        if k_async else ()
+    )
 
     tel = telemetry.rings_enabled()
     runner, pass_size = build_partnered_runner(
@@ -920,6 +1094,7 @@ def run_sharded_partnered_sim(
         ring_mode=ring_mode, delay_values=delay_values, telemetry_on=tel,
         exchange_mode="delta" if delta_on else "dense",
         delta_capacity=capacity,
+        async_k=k_async, async_staleness=async_staleness,
     )
     seed_arr = np.uint32(seed & 0xFFFFFFFF)
     n_share_shards = mesh.shape[SHARES_AXIS]

@@ -33,13 +33,21 @@ Fixtures:
              rounds the cumsum and a capacity-C buffer silently keeps
              the wrong words; the integer-only audit (J2) must flag the
              inexact avals
+  async      a bounded-staleness accounting step (the async exchange's
+             ``staleness`` telemetry column) whose late-fold tally
+             drifts through float32 — past ~2^24 accumulated stale
+             word-folds the column silently saturates low and the
+             staleness <= (K-1) * stale_folds bound reads as satisfied
+             when it is not; the integer-only audit (J2, same
+             discipline as the real ``flood_runner[async]`` entries)
+             must flag the inexact avals
 """
 
 from __future__ import annotations
 
 FIXTURES = (
     "f64", "recompile", "prng", "telemetry", "digest", "exchange",
-    "meshfact",
+    "meshfact", "async",
 )
 
 
@@ -236,6 +244,49 @@ def exchange_fixture() -> dict:
     }
 
 
+def async_fixture() -> dict:
+    """Audit a deliberately-bad async staleness accounting step: the
+    per-tick ``staleness`` column (added-lateness word-folds charged
+    against the pre-advance landed view) tallied through float32 — the
+    dtype leak that saturates the counter low past the 2^24 mantissa
+    and silently blesses a broken staleness bound. The integer-only
+    audit (J2, the discipline the real async runner entries are
+    registered under) must flag the inexact avals."""
+    import jax.numpy as jnp
+
+    from p2p_gossip_tpu.staticcheck.jaxpr_audit import audit_entry
+    from p2p_gossip_tpu.staticcheck.registry import AuditEntry, AuditSpec
+
+    def bad_staleness_row(landed_view, amounts):
+        # The seeded bug: remote late-folds counted in float32. Exact
+        # only below 2^24 folds — a 100K-node mesh at full frontier
+        # blows past it within a run, rounding the column down.
+        remote = (landed_view != 0).any(axis=-1)
+        folds = remote.astype(jnp.float32).sum(axis=-1)
+        stale = (folds * amounts.astype(jnp.float32)).sum()
+        return stale.astype(jnp.uint32)
+
+    def spec():
+        return AuditSpec(
+            args=(
+                jnp.zeros((2, 16, 2), dtype=jnp.uint32),
+                jnp.zeros((2,), dtype=jnp.int32),
+            ),
+            integer_only=True,
+        )
+
+    entry = AuditEntry(
+        name="fixtures.async_bad_staleness_row",
+        fn=bad_staleness_row, spec=spec,
+    )
+    violations = audit_entry(entry)
+    return {
+        "fixture": "async",
+        "ok": not violations,  # must come back False
+        "violations": [v.as_dict() for v in violations],
+    }
+
+
 def meshfact_fixture() -> dict:
     """Seeded axis-split drift: the campaign drivers bake the
     (replicas, nodes) factorization into every jit signature, so
@@ -287,4 +338,6 @@ def run_fixture(name: str) -> dict:
         return exchange_fixture()
     if name == "meshfact":
         return meshfact_fixture()
+    if name == "async":
+        return async_fixture()
     raise ValueError(f"unknown fixture {name!r}; valid: {FIXTURES}")

@@ -59,7 +59,9 @@ def _trace(fn, args, kwargs):
 def _ring_avals(closed) -> list[tuple]:
     """Shapes of metric-ring-like avals: uint32, rank >= 2, minor axis
     exactly NUM_METRICS — the ring's unmistakable signature (bitmask
-    word widths are powers of two >= 1; NUM_METRICS is 7)."""
+    word widths are powers of two >= 1, delta capacities and the
+    exchange-counter row are multiples of 8; NUM_METRICS is 9 and must
+    stay odd so no kernel array can alias it)."""
     found = []
     for aval in _avals_of(closed):
         dtype = getattr(aval, "dtype", None)

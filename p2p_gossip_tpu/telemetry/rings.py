@@ -114,16 +114,21 @@ def row(
     or_work,
     loss_dropped,
     exchange_words=0,
+    staleness=0,
+    stale_folds=0,
 ) -> jnp.ndarray:
     """Assemble one ring row in METRIC_COLUMNS order.
     ``exchange_words`` defaults to 0 — single-device kernels have no
-    cross-shard state exchange to price."""
+    cross-shard state exchange to price — and ``staleness`` /
+    ``stale_folds`` to 0: only the async sharded runners
+    (parallel/async_ticks.py) consume late frontier views."""
     return jnp.stack(
         [
             jnp.asarray(v, dtype=jnp.uint32)
             for v in (
                 frontier_bits, frontier_nodes, newly_infected,
                 msgs_gathered, or_work, loss_dropped, exchange_words,
+                staleness, stale_folds,
             )
         ]
     )
@@ -136,6 +141,8 @@ def flood_row(
     degree: jnp.ndarray,          # (N,) int32
     arrivals_lossless=None,       # (N, W) the same gather with loss off
     exchange_words=0,             # scalar: per-chip exchange words received
+    staleness=0,                  # scalar: async added-staleness ticks
+    stale_folds=0,                # scalar: async stale remote-fold events
 ) -> jnp.ndarray:
     """The flood engines' per-tick row (shared by the solo, campaign and
     sharded tick bodies — all three call `_tick_body`-equivalent math).
@@ -143,7 +150,8 @@ def flood_row(
     and actual gathers, exact in message *bits* (a bit dropped on every
     one of its arriving edges counts once). ``exchange_words`` is the
     sharded runners' per-chip state-slice exchange traffic this tick
-    (schema docstring); solo engines leave the default 0."""
+    (schema docstring); ``staleness`` the async runners' added-staleness
+    ticks consumed this tick; solo engines leave both defaults 0."""
     pc_new = bitmask.popcount_rows(newly_out)
     gathered = total_bits(arrivals)
     dropped = (
@@ -159,6 +167,8 @@ def flood_row(
         or_work=u32sum(jnp.where(pc_new > 0, degree, 0)),
         loss_dropped=dropped,
         exchange_words=exchange_words,
+        staleness=staleness,
+        stale_folds=stale_folds,
     )
 
 

@@ -57,6 +57,21 @@ per-engine semantics and the overflow bound):
                   a single shard. Push-direction digest traffic is NOT
                   included — this column prices the state-slice
                   exchange the dense/delta paths trade off.
+  staleness       added staleness ticks consumed this tick under the
+                  bounded-staleness async exchange (exchange="async",
+                  parallel/async_ticks.py): the sum over async delay
+                  groups x node shards of (max(d, K) - d) for each
+                  group whose remote (cross-shard) frontier view held
+                  any bit — i.e. how many ticks late the bits folded in
+                  this tick ran, charged only when remote bits were
+                  actually pending. 0 on every synchronous path and for
+                  K=1 (the sync-equivalent anchor).
+  stale_folds     count of stale remote-fold events this tick (async
+                  delay groups with max(d, K) > d whose remote view
+                  held pending bits, summed over node shards) — the
+                  denominator for ``staleness``: staleness/stale_folds
+                  is the mean added lateness per fold, bounded by K-1.
+                  0 on every synchronous path.
 """
 
 from __future__ import annotations
@@ -71,6 +86,8 @@ METRIC_COLUMNS = (
     "or_work",
     "loss_dropped",
     "exchange_words",
+    "staleness",
+    "stale_folds",
 )
 NUM_METRICS = len(METRIC_COLUMNS)
 

@@ -496,6 +496,50 @@ def main() -> int:
                       "on the first window with a real multi-chip mesh)")
             print()
 
+    asy = by_stage.get("async_ticks")
+    if asy and asy["results"]:
+        legs = [r for r in asy["results"] if "exchange_mode" in r]
+        if legs:
+            print("## Bounded-staleness async ticks (K-ahead frontiers, "
+                  "host-mesh rehearsal; K=1 bitwise == sync, K>=2 "
+                  "fixed-point-checked)\n")
+            print(md_table([
+                {
+                    "leg": (
+                        f"{r.get('ring_mode')}/{r.get('exchange_mode')}"
+                        + (f"/K{r['async_k']}" if r.get("async_k") else "")
+                    ),
+                    "nodes": r.get("nodes"),
+                    "topology": r.get("topology"),
+                    "wall_s": r.get("wall_s"),
+                    "wall_per_tick_s": r.get("wall_per_tick_s"),
+                    "modeled_overlap_fraction": (
+                        (r.get("exchange") or {})
+                        .get("modeled_overlap_fraction")
+                    ),
+                }
+                for r in legs
+            ], ["leg", "nodes", "topology", "wall_s", "wall_per_tick_s",
+                "modeled_overlap_fraction"]))
+            sync = next(
+                (r for r in legs
+                 if r.get("ring_mode") == "sharded"
+                 and not r.get("async_k")), None)
+            best = min(
+                (r for r in legs if (r.get("async_k") or 0) >= 2
+                 and r.get("wall_per_tick_s")),
+                key=lambda r: r["wall_per_tick_s"], default=None)
+            if (sync and best and sync.get("wall_per_tick_s")
+                    and best["wall_per_tick_s"]):
+                ratio = sync["wall_per_tick_s"] / best["wall_per_tick_s"]
+                print(f"\nsync/async wall-per-tick ratio: {ratio:.2f}x "
+                      f"(best async leg K={best.get('async_k')} vs the "
+                      "synchronous sharded exchange on the same run)")
+            if asy.get("pending_tpu"):
+                print("\n(host-mesh CPU record — pending_tpu: re-captured "
+                      "on the first window with a real multi-chip mesh)")
+            print()
+
     for stage, title in (
         ("scale1m", "1M north star (ER p=0.001, 64-share staging plan)"),
         ("scale1m_ba", "1M scale-free (BA m=3)"),

@@ -55,6 +55,28 @@ if ! JAX_PLATFORMS=cpu python scripts/divergence.py --pair native-sync \
   exit 1
 fi
 
+# Async smoke: the bounded-staleness runner (exchange="async", K=2) must
+# stay digest-identical to the synchronous run on the clamped delay
+# line, and the bisector must still name an injected fault on that pair
+# (the pair shards a 2x2 mesh — XLA_FLAGS forces 8 virtual CPU devices,
+# matching tests/conftest.py).
+if ! JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python scripts/divergence.py --pair sync-async \
+    --n 64 --shares 3 --horizon 16 --json > /tmp/_t1_async.json; then
+  echo "ci_tier1: FAIL — async digest smoke (see /tmp/_t1_async.json;" \
+       "run 'python scripts/divergence.py --pair sync-async' to" \
+       "reproduce)" >&2
+  exit 1
+fi
+if ! JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python scripts/divergence.py --pair sync-async \
+    --n 64 --shares 3 --horizon 16 --inject-fault 4 --json \
+    > /tmp/_t1_async_fault.json; then
+  echo "ci_tier1: FAIL — async fault-injection self-test (see" \
+       "/tmp/_t1_async_fault.json)" >&2
+  exit 1
+fi
+
 # Marker registration check: `pytest --markers` must list `slow`.
 if ! JAX_PLATFORMS=cpu python -m pytest --markers -p no:cacheprovider 2>/dev/null \
     | grep -q "^@pytest.mark.slow:"; then

@@ -33,6 +33,14 @@ Pairs:
                    factorized (replicas x nodes) campaign
                    (``batch.campaign_sharded``) on the same node-shard
                    count (skipped when fewer than 4 devices)
+  sync-async       sharded flood runner with cross-shard delays clamped
+                   to K=2 host-side (the async contract's reference
+                   semantics, ``parallel.async_ticks.clamp_flood_delays``)
+                   vs the bounded-staleness async runner
+                   (``exchange="async"``, ``async_k=2``) — the K-ahead
+                   double-buffered frontier must be bit-identical to
+                   the clamped-delay sync run, tick for tick (skipped
+                   when fewer than 4 devices)
 
 ``--inject-fault T`` is the bisector's self-test: after collecting each
 pair it flips one bit of the second stream's digest at tick T and
@@ -63,6 +71,7 @@ PAIRS = (
     "sync-sharded",
     "sync-delta",
     "sharded-campaign",
+    "sync-async",
 )
 
 
@@ -309,6 +318,54 @@ def pair_sharded_campaign(args):
     return solo, camp
 
 
+def pair_sync_async(args):
+    import jax
+
+    if len(jax.devices()) < 4:
+        return None
+    from p2p_gossip_tpu.models.latency import lognormal_delays
+    from p2p_gossip_tpu.parallel import async_ticks
+    from p2p_gossip_tpu.parallel.engine_sharded import run_sharded_sim
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+    from p2p_gossip_tpu.telemetry import compare
+
+    graph, sched = _workload(args)
+    mesh = make_mesh(2, 2)
+    delays = lognormal_delays(
+        graph, mean_ticks=2.0, sigma=0.5, max_ticks=4, seed=args.seed
+    )
+    k = 2
+    # The async contract: async(K) == sync with cross-shard delays
+    # clamped to max(d, K) host-side.  Stream a runs the plain sharded
+    # runner on the pre-clamped delay line; stream b runs async K=2 on
+    # the original delays.  Per-tick digests must be identical, so
+    # --inject-fault bisects this pair like any other.
+    ref_delays = async_ticks.clamp_flood_delays(
+        graph, 2, k, ell_delays=delays
+    )
+    sync_events = _capture_events(
+        lambda: run_sharded_sim(
+            graph, sched, args.horizon, mesh, chunk_size=args.chunk,
+            ring_mode="sharded", ell_delays=ref_delays,
+        )
+    )
+    async_events = _capture_events(
+        lambda: run_sharded_sim(
+            graph, sched, args.horizon, mesh, chunk_size=args.chunk,
+            exchange="async", async_k=k, ell_delays=delays,
+        )
+    )
+    sync = compare.select_stream(
+        compare.digest_streams(sync_events), kernel="engine_sharded",
+        shard=0,
+    )
+    async_ = compare.select_stream(
+        compare.digest_streams(async_events), kernel="engine_sharded",
+        shard=0,
+    )
+    return sync, async_
+
+
 _PAIR_FNS = {
     "native-sync": pair_native_sync,
     "sync-campaign": pair_sync_campaign,
@@ -316,6 +373,7 @@ _PAIR_FNS = {
     "sync-sharded": pair_sync_sharded,
     "sync-delta": pair_sync_delta,
     "sharded-campaign": pair_sharded_campaign,
+    "sync-async": pair_sync_async,
 }
 
 

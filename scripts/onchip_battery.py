@@ -59,6 +59,15 @@ Stages (value-first within safety bands — see the note after the list):
                the sequential solo-sharded loop. Host-mesh CPU by
                design (like exchange); records carry pending_tpu until
                a real multi-chip mesh is attached.
+  async_ticks — mesh_rehearsal.py --async-k 1,2,4 at the acceptance
+               shape (100K BA, 8-way node shard, dense + delta
+               transports): the bounded-staleness async read path next
+               to its synchronous twins — K=1 bitwise-equal, K>=2
+               fixed-point-equal (the parity ladder asserts inside the
+               script), warm wall per tick and modeled overlap fraction
+               per leg in the rows. Host-mesh CPU by design (like
+               exchange); records carry pending_tpu until a real
+               multi-chip mesh is attached.
   scale1m   — scale_1m.py --shares 64 --chunk 64 -> the 1M ER on-chip
                line at the minimal resident footprint (pad W=2, ~5.2 GB
                modeled = essentially the bare ELL). The full-config
@@ -134,7 +143,7 @@ ART_DIR = os.path.join(REPO, "docs", "artifacts")
 STAGE_ORDER = (
     "bench", "protocols", "kernel", "bench_rep2", "bench_rep3",
     "campaign", "staticcheck", "telemetry", "flightrec", "exchange",
-    "campaign_sharded",
+    "campaign_sharded", "async_ticks",
     "scale1m", "scale1m_ba", "sweep250", "profile", "scale1m_full",
 )
 
@@ -146,7 +155,7 @@ STAGE_ORDER = (
 # --skip-done stops counting a pending record as done the moment the
 # probe sees such a mesh, so the first multi-chip window re-runs these
 # rows on hardware (ROADMAP: PR 11 exchange follow-up).
-PENDING_TPU_STAGES = ("exchange", "campaign_sharded")
+PENDING_TPU_STAGES = ("exchange", "campaign_sharded", "async_ticks")
 
 
 def log(msg: str) -> None:
@@ -312,6 +321,19 @@ def stage_specs(args) -> dict:
                     "--nodes", "2000", "--prob", "0.01", "--shares", "16",
                     "--horizon", "24", "--replicas", "4",
                     "--replica-shards", "2", "--exchange", "ab",
+                ],
+                "env": cpu,
+                "budget": args.stage_budget or 900,
+            },
+            "async_ticks": {
+                # Bounded-staleness async legs at smoke shapes: sync,
+                # K=1 (bitwise anchor), and K=2 (fixed-point check)
+                # dense legs side by side, parity asserted inside the
+                # script before any timing lands in a row.
+                "argv": [
+                    py, os.path.join(SCRIPTS, "mesh_rehearsal.py"),
+                    "--nodes", "2000", "--prob", "0.01", "--shares", "16",
+                    "--horizon", "24", "--async-k", "1,2",
                 ],
                 "env": cpu,
                 "budget": args.stage_budget or 900,
@@ -517,6 +539,26 @@ def stage_specs(args) -> dict:
                 "--topology", "ba", "--nodes", "100000", "--baM", "3",
                 "--shares", "64", "--horizon", "48", "--replicas", "4",
                 "--replica-shards", "2", "--exchange", "ab",
+            ],
+            "env": sweep_env,
+            "budget": args.stage_budget or 3600,
+        },
+        "async_ticks": {
+            # Bounded-staleness async ticks at the acceptance shape:
+            # the 100K BA graph node-sharded 8 ways, sync dense + delta
+            # legs next to async K in {1, 2, 4} on both transports
+            # (mesh_rehearsal --async-k). The script asserts the parity
+            # ladder before timing — K=1 bitwise-equal to the sync legs,
+            # K>=2 equal at the fixed point — so the wall_per_tick_s and
+            # modeled_overlap_fraction in each row are parity-certified.
+            # Host-mesh CPU by design (PENDING_TPU_STAGES note): overlap
+            # mechanics evidence, not a chip number; the record stays
+            # pending_tpu until a real multi-chip mesh is attached.
+            "argv": [
+                py, os.path.join(SCRIPTS, "mesh_rehearsal.py"),
+                "--topology", "ba", "--nodes", "100000", "--baM", "3",
+                "--shares", "64", "--horizon", "48", "--exchange", "ab",
+                "--async-k", "1,2,4", "--skip-parity",
             ],
             "env": sweep_env,
             "budget": args.stage_budget or 3600,

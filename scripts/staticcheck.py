@@ -4,7 +4,7 @@
     python scripts/staticcheck.py --json       # one JSON line on stdout
     python scripts/staticcheck.py --fixture f64|recompile|prng|
                                            telemetry|digest|exchange|
-                                           meshfact
+                                           meshfact|async
     python scripts/staticcheck.py --compile    # also lower+compile each
                                                # audited entry on the
                                                # default device (the
@@ -97,7 +97,7 @@ def main() -> int:
                     help="one JSON line on stdout instead of the human report")
     ap.add_argument("--fixture",
                     choices=("f64", "recompile", "prng", "telemetry",
-                             "digest", "exchange", "meshfact"),
+                             "digest", "exchange", "meshfact", "async"),
                     help="run one seeded regression fixture; exits non-zero "
                     "iff the analyzer (correctly) flags it")
     ap.add_argument("--lint-only", action="store_true",

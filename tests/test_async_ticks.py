@@ -1,0 +1,437 @@
+"""Bounded-staleness async ticks: the parity ladder that pins the
+contract down (parallel/async_ticks.py module docstring).
+
+Flood: async(K) must be bitwise the synchronous engine run with
+cross-shard edge delays clamped to max(d, K) — per tick, digests
+included, under churn and link loss. Partnered protocols: async(K) must
+be bitwise the same protocol on the host-side-clamped delay array.
+K=1 is the synchronous program routed through the double-buffer, so it
+anchors the ladder against the plain sync run. Staleness costs time,
+never correctness: the ttc probe bounds the percentile skew and the
+telemetry columns account for every late fold.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import p2p_gossip_tpu as pg
+from p2p_gossip_tpu import telemetry
+from p2p_gossip_tpu.batch.campaign import ReplicaSet
+from p2p_gossip_tpu.batch.campaign_sharded import (
+    run_sharded_campaign,
+    run_sharded_protocol_campaign,
+)
+from p2p_gossip_tpu.engine.event import run_event_sim
+from p2p_gossip_tpu.models.generation import Schedule
+from p2p_gossip_tpu.models.latency import lognormal_delays
+from p2p_gossip_tpu.models.linkloss import LinkLossModel
+from p2p_gossip_tpu.parallel import async_ticks
+from p2p_gossip_tpu.parallel.engine_sharded import (
+    run_sharded_flood_coverage,
+    run_sharded_sim,
+)
+from p2p_gossip_tpu.parallel.mesh import make_mesh
+from p2p_gossip_tpu.parallel.protocols_sharded import (
+    run_sharded_partnered_sim,
+)
+from p2p_gossip_tpu.telemetry import compare
+
+
+def _mesh(nodes, shares=1):
+    return make_mesh(nodes, shares, devices=jax.devices("cpu"))
+
+
+# ---------------------------------------------------------------------------
+# Host-side helpers (no compilation).
+# ---------------------------------------------------------------------------
+
+
+def test_parse_exchange():
+    assert async_ticks.parse_exchange("dense", 2) == ("dense", 0)
+    assert async_ticks.parse_exchange("delta", 7) == ("delta", 0)
+    assert async_ticks.parse_exchange("auto", 2) == ("auto", 0)
+    assert async_ticks.parse_exchange("async", 2) == ("auto", 2)
+    assert async_ticks.parse_exchange("async-dense", 1) == ("dense", 1)
+    assert async_ticks.parse_exchange("async-delta", 3) == ("delta", 3)
+    with pytest.raises(ValueError):
+        async_ticks.parse_exchange("bogus", 2)
+    with pytest.raises(ValueError):
+        async_ticks.parse_exchange("async", 0)
+
+
+def test_effective_ring():
+    assert async_ticks.effective_ring(3, 0) == 3
+    assert async_ticks.effective_ring(3, 1) == 3
+    assert async_ticks.effective_ring(2, 4) == 5
+    assert async_ticks.effective_ring(6, 4) == 6
+
+
+def test_group_offsets():
+    offs, idx, amt = async_ticks.group_offsets((1, 2, 3), 2)
+    assert offs == (2, 3)
+    assert idx == (0, 0, 1)
+    assert amt == (1, 0, 0)
+    # K=1 keeps delay-1 groups on the direct synchronous read (off == 1
+    # has no landed slice); deeper delays still prefetch.
+    offs, idx, amt = async_ticks.group_offsets((1, 2), 1)
+    assert offs == (2,)
+    assert idx == (-1, 0)
+    assert amt == (0, 0)
+    with pytest.raises(ValueError):
+        async_ticks.group_offsets((1,), 0)
+
+
+def test_clamp_flood_delays_crosses_only():
+    g = pg.ring_graph(8)
+    k = 3
+    clamped = async_ticks.clamp_flood_delays(g, 2, k)
+    ell_idx, ell_mask = g.ell()
+    n_loc = 4  # 8 nodes over 2 shards
+    rows = np.arange(8)[:, None] // n_loc
+    cross = ell_mask & (ell_idx // n_loc != rows)
+    assert (clamped[cross] == k).all()
+    assert (clamped[~cross & ell_mask] == 1).all()
+    # K<=1 or a single shard: identity.
+    assert (async_ticks.clamp_flood_delays(g, 2, 1) == 1).all()
+    assert (async_ticks.clamp_flood_delays(g, 1, k) == 1).all()
+
+
+def test_clamp_partner_delays():
+    d = np.array([[1, 2], [4, 1]], dtype=np.int32)
+    assert (async_ticks.clamp_partner_delays(d, 1) == d).all()
+    got = async_ticks.clamp_partner_delays(d, 3)
+    assert (got == np.array([[3, 3], [4, 3]])).all()
+
+
+def test_protocol_staleness_amounts():
+    values, amounts = async_ticks.protocol_staleness_amounts([3, 1, 2], 2)
+    assert values == (2, 3)
+    assert amounts == (1, 0)
+    # Several original delays folding into the K bucket keep the worst.
+    values, amounts = async_ticks.protocol_staleness_amounts([1, 2], 3)
+    assert values == (3,)
+    assert amounts == (2,)
+    assert async_ticks.protocol_staleness_amounts([], 2) == ((), ())
+
+
+def test_in_flight_predicate():
+    import jax.numpy as jnp
+
+    hist = jnp.zeros((3, 4), dtype=jnp.uint32)
+    assert not bool(async_ticks.in_flight(hist))
+    landed = jnp.zeros((2, 4), dtype=jnp.uint32).at[1, 2].set(9)
+    assert bool(async_ticks.in_flight(hist, landed))
+    assert bool(async_ticks.in_flight(hist.at[0, 0].set(1), None))
+
+
+def test_ttc_percentiles():
+    cov = np.array([[0, 0], [2, 0], [5, 0], [10, 0]])
+    out = async_ticks.ttc_percentiles(cov, fracs=(0.5, 0.99))
+    assert out.shape == (2, 2)
+    assert list(out[:, 0]) == [2, 3]
+    # A share that never converges reports tick 0 (target is 0).
+    assert list(out[:, 1]) == [0, 0]
+    # 1-D input is a single share.
+    assert async_ticks.ttc_percentiles(cov[:, 0]).shape == (3, 1)
+
+
+def test_modeled_overlap_report():
+    rep = async_ticks.modeled_overlap_report("dense", (1, 3), 2, 2, 4, 2)
+    assert rep["async_k"] == 2
+    assert rep["prefetch_offsets"] == [2, 3]
+    assert rep["staleness_amounts"] == [1, 0]
+    assert rep["modeled_prefetch_words_per_tick"] == 2 * 1 * 4 * 2
+    assert rep["modeled_blocking_words_per_tick"] == 0
+    assert rep["modeled_overlap_fraction"] == 1.0
+    # K=1 over delay-1 edges is the fully blocking synchronous read.
+    rep = async_ticks.modeled_overlap_report("dense", (1,), 1, 2, 4, 2)
+    assert rep["modeled_prefetch_words_per_tick"] == 0
+    assert rep["modeled_blocking_words_per_tick"] == 1 * 1 * 4 * 2
+    assert rep["modeled_overlap_fraction"] == 0.0
+    # Delta's all_to_all footprint always rides the prefetch window.
+    rep = async_ticks.modeled_overlap_report("delta", (1,), 2, 2, 4, 2, 10)
+    assert rep["modeled_prefetch_words_per_tick"] == 1 * 2 * 10
+    assert rep["modeled_blocking_words_per_tick"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Flood parity: async(K) == sync on the clamped delay line.
+# ---------------------------------------------------------------------------
+
+
+def _flood_case(horizon):
+    g = pg.erdos_renyi(64, 0.1, seed=4)
+    d = lognormal_delays(g, mean_ticks=2.0, sigma=0.5, max_ticks=4, seed=7)
+    sched = Schedule(
+        g.n,
+        np.array([0, 17, 40, 55], dtype=np.int32),
+        np.array([0, 0, 2, 4], dtype=np.int32),
+    )
+    churn = pg.random_churn(
+        g.n, horizon, outage_prob=0.2, mean_down_ticks=10, seed=9
+    )
+    loss = LinkLossModel(0.2, seed=11)
+    return g, d, sched, churn, loss
+
+
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_flood_async_matches_clamped_sync(k):
+    horizon = 48
+    g, d, sched, churn, loss = _flood_case(horizon)
+    mesh = _mesh(2, 2)
+    ref = run_sharded_sim(
+        g, sched, horizon, mesh, chunk_size=32, churn=churn, loss=loss,
+        ring_mode="sharded",
+        ell_delays=async_ticks.clamp_flood_delays(g, 2, k, ell_delays=d),
+    )
+    got = run_sharded_sim(
+        g, sched, horizon, mesh, chunk_size=32, churn=churn, loss=loss,
+        exchange="async-dense", async_k=k, ell_delays=d,
+    )
+    assert got.equal_counts(ref), k
+    assert got.extra["exchange"]["async_k"] == k
+    if k == 2:
+        delta = run_sharded_sim(
+            g, sched, horizon, mesh, chunk_size=32, churn=churn, loss=loss,
+            exchange="async-delta", async_k=k, ell_delays=d,
+        )
+        assert delta.equal_counts(ref)
+
+
+def test_flood_async_matches_event_oracle():
+    # The clamped-delay reference holds across ENGINES, not just the
+    # sharded runner: the host event engine on max(d, K) cross-shard
+    # delays replays async(K=2) exactly.
+    horizon, k = 48, 2
+    g, d, sched, _, _ = _flood_case(horizon)
+    ev = run_event_sim(
+        g, sched, horizon,
+        ell_delays=async_ticks.clamp_flood_delays(g, 2, k, ell_delays=d),
+    )
+    got = run_sharded_sim(
+        g, sched, horizon, _mesh(2, 2), chunk_size=32,
+        exchange="async-dense", async_k=k, ell_delays=d,
+    )
+    assert got.equal_counts(ev)
+
+
+def _capture_events(tmp_path, name, run):
+    telemetry.configure(str(tmp_path / name), rings=True)
+    try:
+        run()
+    finally:
+        telemetry.close()
+    events = list(telemetry.events())
+    telemetry.reset()
+    return events
+
+
+def test_flood_async_digest_streams_and_staleness(tmp_path):
+    # Per-tick digest identity (the divergence bisector's sync-async
+    # pair) plus the staleness accounting columns on the same runs.
+    horizon, k = 48, 2
+    g, d, sched, churn, loss = _flood_case(horizon)
+    mesh = _mesh(2, 2)
+    ref_events = _capture_events(
+        tmp_path, "ref.jsonl",
+        lambda: run_sharded_sim(
+            g, sched, horizon, mesh, chunk_size=32, churn=churn, loss=loss,
+            ring_mode="sharded",
+            ell_delays=async_ticks.clamp_flood_delays(g, 2, k, ell_delays=d),
+        ),
+    )
+    async_events = _capture_events(
+        tmp_path, "async.jsonl",
+        lambda: run_sharded_sim(
+            g, sched, horizon, mesh, chunk_size=32, churn=churn, loss=loss,
+            exchange="async-dense", async_k=k, ell_delays=d,
+        ),
+    )
+    a = compare.select_stream(
+        compare.digest_streams(ref_events), kernel="engine_sharded", shard=0
+    )
+    b = compare.select_stream(
+        compare.digest_streams(async_events), kernel="engine_sharded", shard=0
+    )
+    div = compare.first_divergence(a, b)
+    assert not div.diverged
+    assert div.compared > 0
+
+    def _col(events, col):
+        return np.concatenate([
+            np.asarray(e["metrics"][col], dtype=np.int64)
+            for e in events
+            if e.get("type") == "ring" and "engine_sharded" in e.get("kernel", "")
+        ] or [np.zeros(1, dtype=np.int64)])
+
+    # The synchronous reference consumes no staleness; the async run
+    # does, and every stale fold is at most K-1 ticks late.
+    assert _col(ref_events, "staleness").sum() == 0
+    assert _col(ref_events, "stale_folds").sum() == 0
+    st, sf = _col(async_events, "staleness"), _col(async_events, "stale_folds")
+    assert st.sum() > 0
+    assert sf.sum() > 0
+    assert (st <= (k - 1) * sf).all()
+
+
+def test_flood_async_ttc_bound():
+    # Staleness trades ticks for overlap, never correctness: the final
+    # coverage is the sync fixed point, and every per-share ttc
+    # percentile shifts right by at most the K-bounded per-hop factor.
+    horizon, k = 64, 2
+    g = pg.erdos_renyi(48, 0.15, seed=6)
+    d = lognormal_delays(g, mean_ticks=2.0, sigma=0.5, max_ticks=4, seed=3)
+    origins = [0, 31]
+    mesh = _mesh(2, 2)
+    _, cov_sync = run_sharded_flood_coverage(
+        g, origins, horizon, mesh, ell_delays=d, chunk_size=32,
+    )
+    _, cov_async = run_sharded_flood_coverage(
+        g, origins, horizon, mesh, ell_delays=d, chunk_size=32,
+        exchange="async-dense", async_k=k,
+    )
+    cov_sync = np.asarray(cov_sync)[:, : len(origins)]
+    cov_async = np.asarray(cov_async)[:, : len(origins)]
+    assert (cov_sync[-1] == cov_async[-1]).all()
+    p_sync = async_ticks.ttc_percentiles(cov_sync)
+    p_async = async_ticks.ttc_percentiles(cov_async)
+    assert (p_sync <= p_async).all()
+    assert (p_async <= k * p_sync + k).all()
+
+
+# ---------------------------------------------------------------------------
+# Partnered protocols: async(K) == the same runner on clamped delays.
+# ---------------------------------------------------------------------------
+
+
+def _partnered_case():
+    g = pg.erdos_renyi(48, 0.15, seed=3)
+    d = lognormal_delays(g, mean_ticks=2.0, sigma=0.5, max_ticks=4, seed=5)
+    sched = Schedule(
+        g.n,
+        np.array([0, 9, 21], dtype=np.int32),
+        np.array([0, 1, 4], dtype=np.int32),
+    )
+    loss = LinkLossModel(0.25, seed=7)
+    return g, d, sched, loss
+
+
+@pytest.mark.parametrize("protocol", ["pushpull", "pull"])
+def test_partnered_async_matches_clamped_reference(protocol):
+    g, d, sched, loss = _partnered_case()
+    horizon, seed, k = 14, 5, 2
+    mesh = _mesh(2, 2)
+    ref = run_sharded_partnered_sim(
+        g, sched, horizon, mesh, protocol=protocol, seed=seed, loss=loss,
+        ring_mode="sharded",
+        ell_delays=async_ticks.clamp_partner_delays(d, k),
+    )
+    got = run_sharded_partnered_sim(
+        g, sched, horizon, mesh, protocol=protocol, seed=seed, loss=loss,
+        exchange="async-dense", async_k=k, ell_delays=d,
+    )
+    assert got.equal_counts(ref), protocol
+    if protocol == "pushpull":
+        delta = run_sharded_partnered_sim(
+            g, sched, horizon, mesh, protocol=protocol, seed=seed, loss=loss,
+            exchange="async-delta", async_k=k, ell_delays=d,
+        )
+        assert delta.equal_counts(ref)
+
+
+def test_partnered_async_k1_is_sync():
+    g, d, sched, loss = _partnered_case()
+    horizon, seed = 14, 5
+    mesh = _mesh(2, 2)
+    want = run_sharded_partnered_sim(
+        g, sched, horizon, mesh, protocol="pushpull", seed=seed, loss=loss,
+        ell_delays=d,
+    )
+    got = run_sharded_partnered_sim(
+        g, sched, horizon, mesh, protocol="pushpull", seed=seed, loss=loss,
+        exchange="async-dense", async_k=1, ell_delays=d,
+    )
+    assert got.equal_counts(want)
+
+
+def test_partnered_async_rejects_pushk():
+    g, d, sched, _ = _partnered_case()
+    with pytest.raises(ValueError, match="anti-entropy"):
+        run_sharded_partnered_sim(
+            g, sched, 8, _mesh(2, 2), protocol="pushk",
+            exchange="async", async_k=2, ell_delays=d,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Campaigns: replica r stays bitwise its solo async run.
+# ---------------------------------------------------------------------------
+
+
+def _replica_set(g, shares, horizon, seed=11):
+    rng = np.random.default_rng(seed)
+    r_total = 2
+    origins = rng.integers(0, g.n, size=(r_total, shares)).astype(np.int32)
+    gen = rng.integers(0, 4, size=(r_total, shares)).astype(np.int32)
+    seeds = np.arange(300, 300 + r_total, dtype=np.int64)
+    return ReplicaSet(g.n, origins, gen, seeds)
+
+
+def _campaign_meshes():
+    devices = jax.devices("cpu")
+    return (
+        make_mesh(2, devices=devices[:4], replicas=2),
+        make_mesh(2, 1, devices=devices[:2]),
+    )
+
+
+def test_flood_campaign_async_matches_solo():
+    horizon, k = 24, 2
+    g = pg.erdos_renyi(48, 0.12, seed=8)
+    d = lognormal_delays(g, mean_ticks=2.0, sigma=0.5, max_ticks=4, seed=2)
+    reps = _replica_set(g, 4, horizon)
+    mesh_c, mesh_s = _campaign_meshes()
+    loss = LinkLossModel(0.2, seed=0)
+    lseeds = [101, 202]
+    res = run_sharded_campaign(
+        g, reps, horizon, mesh_c, ell_delays=d, loss=loss, loss_seeds=lseeds,
+        exchange="async-dense", async_k=k,
+    )
+    assert res.extra["exchange"]["async_k"] == k
+    for r in range(reps.num_replicas):
+        solo = run_sharded_sim(
+            g, reps.replica_schedule(r, horizon), horizon, mesh_s,
+            chunk_size=reps.shares_per_replica, ell_delays=d,
+            loss=LinkLossModel(0.2, seed=lseeds[r]),
+            exchange="async-dense", async_k=k,
+        )
+        assert np.array_equal(res.received[r], solo.received[: g.n]), r
+        assert np.array_equal(res.sent[r], solo.sent[: g.n]), r
+
+
+def test_protocol_campaign_async_matches_solo():
+    horizon, k = 16, 2
+    g = pg.erdos_renyi(48, 0.12, seed=8)
+    d = lognormal_delays(g, mean_ticks=2.0, sigma=0.5, max_ticks=4, seed=2)
+    reps = _replica_set(g, 4, horizon)
+    mesh_c, mesh_s = _campaign_meshes()
+    res = run_sharded_protocol_campaign(
+        g, reps, horizon, mesh_c, protocol="pushpull", ell_delays=d,
+        exchange="async-dense", async_k=k,
+    )
+    for r in range(reps.num_replicas):
+        solo = run_sharded_partnered_sim(
+            g, reps.replica_schedule(r, horizon), horizon, mesh_s,
+            protocol="pushpull", seed=int(reps.seeds[r]),
+            chunk_size=reps.shares_per_replica, ell_delays=d,
+            exchange="async-dense", async_k=k,
+        )
+        assert np.array_equal(res.received[r], solo.received[: g.n]), r
+        assert np.array_equal(res.sent[r], solo.sent[: g.n]), r
+    with pytest.raises(ValueError, match="anti-entropy"):
+        run_sharded_protocol_campaign(
+            g, reps, horizon, mesh_c, protocol="pushk",
+            exchange="async", async_k=k,
+        )

@@ -176,6 +176,30 @@ def test_compress_scatter_roundtrip_exact():
         assert np.array_equal(canvas, np.where(need[:, d:d + 1], changed, 0))
 
 
+def test_compress_aggregate_bitwise_identical():
+    """aggregate=True (destination-major single-flat-scatter packing)
+    must reproduce the default path bit-for-bit — idx, val, counts —
+    including truncating overflow and all-empty destinations."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    for n_loc, w, n_dests, cap in [(12, 3, 3, 40), (16, 2, 4, 8), (5, 1, 2, 8)]:
+        changed = rng.integers(0, 2**32, (n_loc, w), dtype=np.uint32)
+        changed[rng.random((n_loc, w)) < 0.5] = 0
+        need = rng.random((n_loc, n_dests)) < 0.5
+        need[:, -1] = False  # one destination with no candidates at all
+        base = exch.compress_deltas(
+            jnp.asarray(changed), jnp.asarray(need), cap
+        )
+        agg = exch.compress_deltas(
+            jnp.asarray(changed), jnp.asarray(need), cap, aggregate=True
+        )
+        for b, a, name in zip(base, agg, ("idx", "val", "counts")):
+            assert np.array_equal(np.asarray(b), np.asarray(a)), (
+                n_loc, w, n_dests, cap, name
+            )
+
+
 def test_compress_overflow_reports_true_counts():
     import jax.numpy as jnp
 

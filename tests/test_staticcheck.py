@@ -276,6 +276,14 @@ def test_exchange_fixture_flagged():
     assert {"integer-only"} <= {v["rule"] for v in report["violations"]}
 
 
+def test_async_fixture_flagged():
+    from p2p_gossip_tpu.staticcheck.fixtures import async_fixture
+
+    report = async_fixture()
+    assert not report["ok"]
+    assert {"integer-only"} <= {v["rule"] for v in report["violations"]}
+
+
 # ---------------------------------------------------------------------------
 # CLI contract (the thing ci_tier1.sh and bench.py shell out to)
 # ---------------------------------------------------------------------------

@@ -444,5 +444,11 @@ def test_ring_signature_shape_is_stable():
     """The zero-cost checker keys on the ring's (cap, NUM_METRICS)
     uint32 signature; a column added without updating the checker (and
     the schema) must fail loudly here."""
-    assert schema.NUM_METRICS == len(schema.METRIC_COLUMNS) == 7
-    assert schema.METRIC_COLUMNS[-1] == "exchange_words"
+    assert schema.NUM_METRICS == len(schema.METRIC_COLUMNS) == 9
+    # Deliberately odd: an even power-of-two count would alias the
+    # checker's ring-shape detection against bitmask widths (powers of
+    # two) and the (., 8) exchange-counter rows.
+    assert schema.NUM_METRICS % 2 == 1
+    assert schema.METRIC_COLUMNS[-3:] == (
+        "exchange_words", "staleness", "stale_folds",
+    )
