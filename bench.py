@@ -534,6 +534,39 @@ def main() -> None:
         except Exception as e:
             log(f"async-ticks leg: did not complete ({type(e).__name__})")
 
+    # Gossip-as-a-service (serve/): a CI-sized mixed request trace
+    # through the continuous-batching server on an 8-virtual-device CPU
+    # subprocess (scripts/serve_bench.py --smoke), every request
+    # bitwise-verified against a solo campaign run before the row is
+    # accepted. Platform-labeled inside ("platform": "cpu"); chip-scale
+    # serving numbers are the battery's serve stage. None on smoke or
+    # when the leg could not run.
+    serve = None
+    if not smoke:
+        sv_args = [sys.executable, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts",
+            "serve_bench.py"), "--smoke"]
+        try:
+            svr = subprocess.run(
+                sv_args, capture_output=True, text=True, timeout=600,
+                env=sc_env,
+            )
+            if svr.returncode == 0:
+                serve = json.loads(svr.stdout.strip().splitlines()[-1])
+                log(
+                    "serve leg: "
+                    f"{serve['requests']} requests @ "
+                    f"{serve['requests_per_s']}/s, p99 "
+                    f"{serve['p99_turnaround_s']}s, occupancy "
+                    f"{serve['slot_occupancy']}, bitwise_ok="
+                    f"{serve['bitwise_ok']} (cpu subprocess)"
+                )
+            else:
+                log(f"serve leg: FAIL (rc={svr.returncode}) "
+                    f"{svr.stderr[-400:]}")
+        except Exception as e:
+            log(f"serve leg: did not complete ({type(e).__name__})")
+
     row = {
         "metric": (
             f"node-updates/sec ({n // 1000}K-node p={p:g} gossip "
@@ -588,6 +621,12 @@ def main() -> None:
         # overlap fraction per leg, every leg parity-certified before
         # timing. None on smoke or when the leg could not run.
         "async_ticks": async_ticks,
+        # Continuous-batching serving row (scripts/serve_bench.py
+        # --smoke): requests/s, p50/p99 turnaround, slot occupancy and
+        # the per-request bitwise-parity verdict for a mixed trace
+        # (platform-labeled "cpu"). None on smoke or when the leg could
+        # not run.
+        "serve": serve,
     }
     row["campaign"] = {
         "metric": (

@@ -192,6 +192,42 @@ def make_mesh(
     return Mesh(dev_array, (SHARES_AXIS, NODES_AXIS))
 
 
+def make_slot_mesh(
+    slots: int,
+    devices=None,
+    node_bytes: int | None = None,
+    hbm_bytes: int | None = None,
+) -> Mesh:
+    """Slot→mesh placement for the serving scheduler (serve/server.py):
+    a factorized ``(replicas, nodes)`` mesh whose replica axis width
+    DIVIDES the server's slot count, so every dispatch of ``slots``
+    vmap rows splits evenly across replica shards (the server requires
+    ``slots % replica_shards == 0``).
+
+    Starts from ``auto_axis_split``'s HBM-driven factorization and then
+    shrinks the replica axis to the largest divisor of the device count
+    that also divides ``slots`` — surplus devices go to the node axis
+    when that still fills the mesh, otherwise they sit out (a 6-device
+    host serving slots=8 runs a 2x3 mesh, not a broken 6-wide replica
+    axis)."""
+    if slots < 1:
+        raise ValueError(f"slots must be >= 1, got {slots}")
+    probe = make_mesh(devices=devices, replicas=1)
+    devices = list(probe.devices.flat)
+    n_dev = len(devices)
+    replica_shards, node_shards = auto_axis_split(
+        n_dev, node_bytes=node_bytes, hbm_bytes=hbm_bytes
+    )
+    while replica_shards > 1 and slots % replica_shards != 0:
+        replica_shards -= 1
+        while n_dev % replica_shards != 0:
+            replica_shards -= 1
+        node_shards = n_dev // replica_shards
+    return make_mesh(
+        n_node_shards=node_shards, devices=devices, replicas=replica_shards
+    )
+
+
 def initialize_multihost(
     coordinator_address: str | None = None,
     num_processes: int | None = None,

@@ -1,0 +1,48 @@
+"""Gossip-as-a-service: a continuous-batching simulation server.
+
+Layout: `request` (the JSON-serializable request model + static
+signature, jax-free), `scheduler` (slot bin-packing + modeled-cost
+admission control, jax-free), `server` (the in-process queue + dispatch
+loop onto the campaign runners). Driver: scripts/serve_bench.py; docs:
+docs/SERVER.md.
+"""
+
+from p2p_gossip_tpu.serve.request import (  # noqa: F401
+    PROTOCOLS,
+    TOPOLOGY_FAMILIES,
+    SimRequest,
+    build_graph,
+    topology_fingerprint,
+    validate_request,
+)
+from p2p_gossip_tpu.serve.scheduler import (  # noqa: F401
+    BatchPlan,
+    SlotScheduler,
+    SlotUnit,
+    modeled_request_cost,
+)
+
+__all__ = [
+    "PROTOCOLS",
+    "TOPOLOGY_FAMILIES",
+    "SimRequest",
+    "build_graph",
+    "topology_fingerprint",
+    "validate_request",
+    "BatchPlan",
+    "SlotScheduler",
+    "SlotUnit",
+    "modeled_request_cost",
+    "GossipServer",
+]
+
+
+def __getattr__(name):
+    # GossipServer pulls in the campaign stack (jax); keep `import
+    # p2p_gossip_tpu.serve` backend-free for clients that only build
+    # requests.
+    if name == "GossipServer":
+        from p2p_gossip_tpu.serve.server import GossipServer
+
+        return GossipServer
+    raise AttributeError(name)

@@ -166,3 +166,119 @@ def measure_compiles(fn_or_name):
     if isinstance(fn_or_name, str):
         return int(kernels[fn_or_name]._cache_size())
     return int(fn_or_name._cache_size())
+
+
+# --- serve-trace sentinel (serve/scheduler.py's one-compile promise) -------
+
+def default_serve_trace() -> list[dict]:
+    """The shipped mixed request trace: 2 topologies x 3 protocols x
+    mixed replica counts, several requests sharing each signature so the
+    replay exercises cross-request slot packing, plus a loss variant
+    (one extra legitimate flood compile). Small enough for tier-1."""
+    er = {"family": "erdos_renyi", "n": 64, "p": 0.1, "seed": 1}
+    ws = {"family": "watts_strogatz", "n": 64, "k": 4, "beta": 0.1,
+          "seed": 2}
+    base = {"shares": 2, "horizon": 12}
+    reqs = [
+        {"topology": er, "protocol": "flood", "seeds": [0, 1, 2]},
+        {"topology": er, "protocol": "flood", "seeds": [3, 4]},
+        {"topology": ws, "protocol": "flood", "seeds": [5]},
+        {"topology": ws, "protocol": "flood", "seeds": [6, 7, 8]},
+        {"topology": er, "protocol": "pushpull", "seeds": [9, 10]},
+        {"topology": er, "protocol": "pushpull", "seeds": [11]},
+        {"topology": ws, "protocol": "pushk", "seeds": [12, 13]},
+        {"topology": er, "protocol": "flood", "seeds": [14, 15],
+         "loss_prob": 0.1},
+    ]
+    return [
+        {"request_id": f"sentinel-{i}", **base, **r}
+        for i, r in enumerate(reqs)
+    ]
+
+
+def _serve_compile_sig(server, req) -> tuple:
+    """The jit-cache signature a request's dispatches hit: the kernel it
+    routes to, the DeviceGraph's pytree structure + leaf shapes/dtypes
+    (exactly what jax's cache keys on for the traced operands), the
+    batch width, and every static argument the campaign runner derives
+    from the request. Mirrors batch/campaign.py's derivations — if a
+    campaign kernel gains a per-request static arg, add it HERE (the
+    sentinel failing "under-compiled expectation" is the reminder)."""
+    import jax
+
+    from p2p_gossip_tpu.engine.sync import MIN_CHUNK_SHARES, _resolve_block
+    from p2p_gossip_tpu.ops import bitmask
+
+    dg = server._device_graph(req)
+    leaves, treedef = jax.tree_util.tree_flatten(dg)
+    dg_sig = (
+        str(treedef),
+        tuple((tuple(l.shape), str(l.dtype)) for l in leaves),
+    )
+    on_tpu = any(d.platform == "tpu" for d in dg.ell_idx.devices())
+    s = int(req.shares)
+    thr = int(round(float(req.loss_prob) * (1 << 32)))
+    loss_on = req.loss_prob > 0.0
+    churn_on = req.churn_prob > 0.0
+    b = server.slots
+    if req.protocol == "flood":
+        floor = MIN_CHUNK_SHARES if on_tpu else min(MIN_CHUNK_SHARES, 128)
+        chunk = bitmask.num_words(max(s, floor)) * bitmask.WORD_BITS
+        block = _resolve_block(dg, None)
+        return (
+            "coverage_batch", dg_sig, b, chunk, int(req.horizon), block,
+            (thr, None) if loss_on else None, loss_on, churn_on, s,
+        )
+    if on_tpu:
+        chunk_size = MIN_CHUNK_SHARES
+    else:
+        chunk_size = min(max(s, 1), min(MIN_CHUNK_SHARES, 128))
+    chunk = bitmask.num_words(max(chunk_size, 1)) * bitmask.WORD_BITS
+    common = (dg_sig, b, chunk, int(req.horizon), thr, churn_on)
+    if req.protocol == "pushk":
+        return ("pushk_replicas",) + common + (int(req.fanout),)
+    return ("pushpull_replicas",) + common + (req.protocol,)
+
+
+def expected_serve_compiles(requests, server) -> dict[str, int]:
+    """Distinct compile signatures per counted kernel for a request
+    trace served at ``server``'s slot width."""
+    sigs: dict[str, set] = {k: set() for k in _KERNELS.values()}
+    for req in requests:
+        sig = _serve_compile_sig(server, req)
+        sigs[sig[0]].add(sig[1:])
+    return {k: len(v) for k, v in sigs.items()}
+
+
+def run_serve_sentinel(trace: list[dict] | None = None) -> SentinelReport:
+    """Replay a mixed request trace through the serving scheduler and
+    fail if any counted campaign kernel compiled more than once per
+    distinct static signature — the continuous-batching premise that
+    backfilled slots reuse already-compiled programs. Like
+    `run_sentinel`, an under-count also fails (the expectation model
+    drifted)."""
+    import jax
+
+    from p2p_gossip_tpu.serve.request import SimRequest
+    from p2p_gossip_tpu.serve.server import GossipServer
+
+    if trace is None:
+        trace = default_serve_trace()
+    requests = [SimRequest.from_dict(d) for d in trace]
+    kernels = _counted_kernels()
+    server = GossipServer(slots=4)
+    expected = expected_serve_compiles(requests, server)
+    jax.clear_caches()
+    for req in requests:
+        server.submit(req)
+    server.drain()
+    measured = {
+        name: int(fn._cache_size()) for name, fn in kernels.items()
+    }
+    ok = all(
+        measured.get(k, 0) == expected.get(k, 0)
+        for k in set(expected) | set(measured)
+    )
+    return SentinelReport(
+        ok=ok, expected=expected, measured=measured, cells=len(requests),
+    )

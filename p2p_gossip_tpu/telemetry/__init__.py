@@ -17,7 +17,9 @@ alignment for the divergence bisector), `chrometrace`
 from p2p_gossip_tpu.telemetry.schema import (  # noqa: F401
     METRIC_COLUMNS,
     NUM_METRICS,
+    REQUEST_EVENTS,
     SCHEMA_VERSION,
+    SUPPORTED_SCHEMAS,
     validate_event,
     validate_stream,
 )

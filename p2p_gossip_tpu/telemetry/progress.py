@@ -118,13 +118,21 @@ def emit_progress(
     ticks_done: int | None = None,
     coverage_pct: float | None = None,
     digest_head: int | None = None,
+    active_requests: int | None = None,
+    queue_depth: int | None = None,
     **provenance,
 ):
     """One per-chunk progress beat: a ``progress`` event into the JSONL
     sink (when enabled) and a heartbeat-file rewrite (when configured).
     ETA extrapolates elapsed wall time over completed chunks — coarse by
     design; it exists so a 6-hour battery stage is distinguishable from
-    a wedge, not to forecast."""
+    a wedge, not to forecast.
+
+    ``active_requests``/``queue_depth`` are the gossip server's
+    multiplexing counters (serve/server.py): when one process drains
+    many requests, the per-chunk cadence alone can't tell "slow batch"
+    from "deep queue" — the watchers' stall heuristics read these from
+    the heartbeat payload to keep their thresholds meaningful."""
     hb_path = heartbeat_path()
     if not sink.enabled() and not hb_path:
         return
@@ -149,6 +157,10 @@ def emit_progress(
         event["coverage_pct"] = round(float(coverage_pct), 4)
     if digest_head is not None:
         event["digest_head"] = f"{int(digest_head) & 0xFFFFFFFF:08x}"
+    if active_requests is not None:
+        event["active_requests"] = int(active_requests)
+    if queue_depth is not None:
+        event["queue_depth"] = int(queue_depth)
     for key, val in provenance.items():
         if val is not None:
             event[key] = val

@@ -8,7 +8,8 @@ are `scripts/run_report.py`, the Chrome-trace exporter
 module is deliberately jax-free so consumers can import it without
 touching a backend.
 
-Event types (SCHEMA_VERSION 1):
+Event types (SCHEMA_VERSION 2 — version 1 streams remain valid; v2 adds
+the ``request``/``slot`` server events, docs/OBSERVABILITY.md):
 
   meta     first line of every stream: {"type": "meta", "schema": 1,
            "run": {"argv": [...], "utc": iso8601, ...}}
@@ -31,7 +32,21 @@ Event types (SCHEMA_VERSION 1):
   progress one per-chunk liveness beat (telemetry/progress.py):
            {"type": "progress", "kernel", "elapsed_s"} plus optional
            "chunk", "chunks_total", "ticks_done", "coverage_pct",
-           "eta_s", "digest_head" (8-hex-digit string).
+           "eta_s", "digest_head" (8-hex-digit string), and — when the
+           gossip server multiplexes runs (serve/server.py) —
+           "active_requests"/"queue_depth".
+  request  one request-lifecycle transition of the gossip server
+           (serve/server.py): {"type": "request", "request_id",
+           "event": one of REQUEST_EVENTS} plus optional "signature"
+           (static-signature key), "protocol", "replicas",
+           "replicas_done", "queue_depth", "turnaround_s", "reason"
+           (rejections), and "cost" (the admission controller's modeled
+           bytes/flops object).
+  slot     one continuous-batching dispatch of the gossip server
+           (serve/scheduler.py): {"type": "slot", "signature", "slots",
+           "occupied", "request_ids": [...]} plus optional "batch"
+           (dispatch ordinal) and "wall_s" — the slot-occupancy record
+           serve_bench.py's occupancy metric reduces over.
 
 Ring columns (uint32 on device — see docs/OBSERVABILITY.md for the
 per-engine semantics and the overflow bound):
@@ -76,7 +91,11 @@ per-engine semantics and the overflow bound):
 
 from __future__ import annotations
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+
+#: Schema versions a consumer accepts: v1 streams (pre-server) carry no
+#: request/slot events but stay valid under every v2 validator.
+SUPPORTED_SCHEMAS = (1, 2)
 
 METRIC_COLUMNS = (
     "frontier_bits",
@@ -91,7 +110,16 @@ METRIC_COLUMNS = (
 )
 NUM_METRICS = len(METRIC_COLUMNS)
 
-EVENT_TYPES = ("meta", "span", "ring", "counter", "digest", "progress")
+EVENT_TYPES = (
+    "meta", "span", "ring", "counter", "digest", "progress", "request",
+    "slot",
+)
+
+#: Request-lifecycle transitions the server emits (serve/server.py).
+REQUEST_EVENTS = (
+    "submitted", "admitted", "rejected", "dispatched", "preempted",
+    "resumed", "done",
+)
 
 
 def validate_event(event) -> list[str]:
@@ -104,10 +132,10 @@ def validate_event(event) -> list[str]:
     if etype not in EVENT_TYPES:
         return [f"unknown event type {etype!r} (valid: {EVENT_TYPES})"]
     if etype == "meta":
-        if event.get("schema") != SCHEMA_VERSION:
+        if event.get("schema") not in SUPPORTED_SCHEMAS:
             errs.append(
-                f"meta.schema is {event.get('schema')!r}, expected "
-                f"{SCHEMA_VERSION}"
+                f"meta.schema is {event.get('schema')!r}, expected one of "
+                f"{SUPPORTED_SCHEMAS}"
             )
         if not isinstance(event.get("run"), dict):
             errs.append("meta.run must be an object")
@@ -181,7 +209,8 @@ def validate_event(event) -> list[str]:
         val = event.get("elapsed_s")
         if not isinstance(val, (int, float)) or val < 0:
             errs.append("progress.elapsed_s must be a number >= 0")
-        for key in ("chunk", "chunks_total", "ticks_done"):
+        for key in ("chunk", "chunks_total", "ticks_done",
+                    "active_requests", "queue_depth"):
             if key in event and (
                 not isinstance(event[key], int) or event[key] < 0
             ):
@@ -199,6 +228,62 @@ def validate_event(event) -> list[str]:
             errs.append("counter.name must be a non-empty string")
         if not isinstance(event.get("value"), (int, float)):
             errs.append("counter.value must be a number")
+    elif etype == "request":
+        rid = event.get("request_id")
+        if not isinstance(rid, str) or not rid:
+            errs.append("request.request_id must be a non-empty string")
+        if event.get("event") not in REQUEST_EVENTS:
+            errs.append(
+                f"request.event is {event.get('event')!r}, expected one of "
+                f"{REQUEST_EVENTS}"
+            )
+        for key in ("replicas", "replicas_done", "queue_depth"):
+            if key in event and (
+                not isinstance(event[key], int) or event[key] < 0
+            ):
+                errs.append(f"request.{key} must be an int >= 0")
+        if "turnaround_s" in event and (
+            not isinstance(event["turnaround_s"], (int, float))
+            or event["turnaround_s"] < 0
+        ):
+            errs.append("request.turnaround_s must be a number >= 0")
+        for key in ("signature", "protocol", "reason"):
+            if key in event and (
+                not isinstance(event[key], str) or not event[key]
+            ):
+                errs.append(f"request.{key} must be a non-empty string")
+        if "cost" in event and not isinstance(event["cost"], dict):
+            errs.append("request.cost must be an object")
+    elif etype == "slot":
+        sig = event.get("signature")
+        if not isinstance(sig, str) or not sig:
+            errs.append("slot.signature must be a non-empty string")
+        slots = event.get("slots")
+        if not isinstance(slots, int) or slots < 1:
+            errs.append("slot.slots must be an int >= 1")
+        occupied = event.get("occupied")
+        if not isinstance(occupied, int) or occupied < 0:
+            errs.append("slot.occupied must be an int >= 0")
+        elif isinstance(slots, int) and slots >= 1 and occupied > slots:
+            errs.append(
+                f"slot.occupied ({occupied}) exceeds slot.slots ({slots})"
+            )
+        rids = event.get("request_ids")
+        if not isinstance(rids, list) or not all(
+            isinstance(r, str) and r for r in rids
+        ):
+            errs.append(
+                "slot.request_ids must be a list of non-empty strings"
+            )
+        if "batch" in event and (
+            not isinstance(event["batch"], int) or event["batch"] < 0
+        ):
+            errs.append("slot.batch must be an int >= 0")
+        if "wall_s" in event and (
+            not isinstance(event["wall_s"], (int, float))
+            or event["wall_s"] < 0
+        ):
+            errs.append("slot.wall_s must be a number >= 0")
     return errs
 
 

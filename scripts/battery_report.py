@@ -540,6 +540,22 @@ def main() -> int:
                       "on the first window with a real multi-chip mesh)")
             print()
 
+    srv = by_stage.get("serve")
+    if srv and srv["results"]:
+        rows = [r for r in srv["results"] if r.get("bench") == "serve"]
+        if rows:
+            print("## Gossip-as-a-service (continuous-batching server, "
+                  "every request bitwise-verified vs solo runs)\n")
+            print(md_table(rows, [
+                "platform", "requests", "signatures", "slots", "mesh",
+                "batches", "requests_per_s", "p50_turnaround_s",
+                "p99_turnaround_s", "slot_occupancy", "bitwise_ok",
+            ]))
+            if srv.get("pending_tpu"):
+                print("\n(host-mesh CPU record — pending_tpu: re-captured "
+                      "on the first window with a real multi-chip mesh)")
+            print()
+
     for stage, title in (
         ("scale1m", "1M north star (ER p=0.001, 64-share staging plan)"),
         ("scale1m_ba", "1M scale-free (BA m=3)"),

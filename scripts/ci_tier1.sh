@@ -77,6 +77,19 @@ if ! JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   exit 1
 fi
 
+# Server smoke: a mixed request trace (12 requests, 2 topologies x 3
+# protocols x mixed replica counts) drained in-process through the
+# continuous-batching server on an 8-virtual-device slot mesh, each
+# request bitwise-compared against a solo batch/campaign run with the
+# same seeds (scripts/serve_bench.py exits non-zero on any mismatch or
+# non-done request).
+if ! JAX_PLATFORMS=cpu python scripts/serve_bench.py --smoke \
+    > /tmp/_t1_serve.json; then
+  echo "ci_tier1: FAIL — server smoke (see /tmp/_t1_serve.json; run" \
+       "'python scripts/serve_bench.py --smoke' to reproduce)" >&2
+  exit 1
+fi
+
 # Marker registration check: `pytest --markers` must list `slow`.
 if ! JAX_PLATFORMS=cpu python -m pytest --markers -p no:cacheprovider 2>/dev/null \
     | grep -q "^@pytest.mark.slow:"; then

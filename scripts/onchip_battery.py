@@ -68,6 +68,15 @@ Stages (value-first within safety bands — see the note after the list):
                per leg in the rows. Host-mesh CPU by design (like
                exchange); records carry pending_tpu until a real
                multi-chip mesh is attached.
+  serve     — serve_bench.py at the acceptance trace (100 mixed
+               requests, 2 topologies x 3 protocols x mixed replica
+               counts, every request bitwise-verified against a solo
+               campaign run): requests/s, p50/p99 turnaround and slot
+               occupancy for the continuous-batching server. Runs on
+               the 8-virtual-device host slot mesh by design (the slot
+               mesh wants >= 4 devices; the tunnel attaches one chip) —
+               records carry pending_tpu until a real multi-chip mesh
+               is attached, like the other host-mesh stages.
   scale1m   — scale_1m.py --shares 64 --chunk 64 -> the 1M ER on-chip
                line at the minimal resident footprint (pad W=2, ~5.2 GB
                modeled = essentially the bare ELL). The full-config
@@ -143,7 +152,7 @@ ART_DIR = os.path.join(REPO, "docs", "artifacts")
 STAGE_ORDER = (
     "bench", "protocols", "kernel", "bench_rep2", "bench_rep3",
     "campaign", "staticcheck", "telemetry", "flightrec", "exchange",
-    "campaign_sharded", "async_ticks",
+    "campaign_sharded", "async_ticks", "serve",
     "scale1m", "scale1m_ba", "sweep250", "profile", "scale1m_full",
 )
 
@@ -155,7 +164,9 @@ STAGE_ORDER = (
 # --skip-done stops counting a pending record as done the moment the
 # probe sees such a mesh, so the first multi-chip window re-runs these
 # rows on hardware (ROADMAP: PR 11 exchange follow-up).
-PENDING_TPU_STAGES = ("exchange", "campaign_sharded", "async_ticks")
+PENDING_TPU_STAGES = (
+    "exchange", "campaign_sharded", "async_ticks", "serve",
+)
 
 
 def log(msg: str) -> None:
@@ -349,6 +360,18 @@ def stage_specs(args) -> dict:
                     "--nodes", "2000", "--prob", "0.01", "--shares", "32",
                     "--horizon", "24", "--chunkSize", "32",
                     "--exchange", "ab", "--partition",
+                ],
+                "env": cpu,
+                "budget": args.stage_budget or 900,
+            },
+            "serve": {
+                # Continuous-batching server smoke: 12 mixed requests
+                # drained on the 8-virtual-device slot mesh, every
+                # request bitwise-compared against its solo campaign
+                # run inside the script.
+                "argv": [
+                    py, os.path.join(SCRIPTS, "serve_bench.py"),
+                    "--smoke",
                 ],
                 "env": cpu,
                 "budget": args.stage_budget or 900,
@@ -562,6 +585,25 @@ def stage_specs(args) -> dict:
             ],
             "env": sweep_env,
             "budget": args.stage_budget or 3600,
+        },
+        "serve": {
+            # The serving acceptance trace: 100 mixed requests (2
+            # topology fingerprints x 3 protocols x replica counts
+            # cycling 1/2/4, plus a lossy-flood signature) through the
+            # continuous-batching server, drained on the slot mesh, and
+            # every request re-derived by a solo batch/campaign run and
+            # compared bitwise before the row is accepted. serve_bench
+            # pins the 8-virtual-device host CPU mesh when no platform
+            # is requested (PENDING_TPU_STAGES note): serving-mechanics
+            # + packing-throughput evidence, not a chip number; the
+            # record stays pending_tpu until a real multi-chip mesh is
+            # attached.
+            "argv": [
+                py, os.path.join(SCRIPTS, "serve_bench.py"),
+                "--requests", "100",
+            ],
+            "env": sweep_env,
+            "budget": args.stage_budget or 1800,
         },
         "scale1m": {
             # The minimal-footprint rung of the 1M ladder: --chunk 64

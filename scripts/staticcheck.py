@@ -13,8 +13,10 @@
 Runs, in order: the AST lint (astlint — no jax needed), the jaxpr
 invariant auditor over every registered entry point (jaxpr_audit), the
 telemetry zero-cost check (telemetry_off — disabled metric rings must
-compile away), and the recompile sentinel's sweep-grid replay
-(recompile). Exit code 1 iff
+compile away), and the recompile sentinel's replays (recompile): the
+sweep-grid one and the serving scheduler's mixed request trace
+(``run_serve_sentinel`` — one compile per distinct static signature
+across backfilled slots). Exit code 1 iff
 any analyzer reports a violation — which is also the ``--fixture``
 contract: each seeded regression must keep exiting non-zero, and
 tests/test_staticcheck.py asserts exactly that (a broken analyzer shows
@@ -189,6 +191,23 @@ def main() -> int:
             log(f"recompile sentinel: {sentinel.cells} cells, "
                 f"expected {sentinel.expected}, measured {sentinel.measured}")
 
+            from p2p_gossip_tpu.staticcheck.recompile import (
+                run_serve_sentinel,
+            )
+
+            serve_sentinel = run_serve_sentinel()
+            report["serve_recompile"] = {
+                **serve_sentinel.as_dict(),
+                "violations": [
+                    {"rule": "serve-recompile-sentinel", "message": m}
+                    for m in serve_sentinel.violations()
+                ],
+            }
+            violations += len(serve_sentinel.violations())
+            log(f"serve sentinel: {serve_sentinel.cells} requests, "
+                f"expected {serve_sentinel.expected}, "
+                f"measured {serve_sentinel.measured}")
+
         if args.compile:
             import jax
 
@@ -212,7 +231,8 @@ def main() -> int:
     else:
         print(f"staticcheck: {'OK' if report['ok'] else 'FAIL'} "
               f"({violations} violation(s), {report['wall_s']}s)")
-        for section in ("lint", "jaxpr", "telemetry", "recompile", "compile"):
+        for section in ("lint", "jaxpr", "telemetry", "recompile",
+                        "serve_recompile", "compile"):
             sec = report.get(section)
             if not sec:
                 continue

@@ -239,6 +239,84 @@ def test_partnered_checkpoint_rejects_coverage(tmp_path):
         )
 
 
+def test_serve_request_evicted_resumes_into_different_slots(
+    tmp_path, monkeypatch
+):
+    """Preemption contract of the serve layer: a request evicted at a
+    batch boundary loses nothing, its remaining replicas later run in
+    *different slot indices* (behind a newly arrived request), and both
+    the mixed-batch completion and a fresh-server checkpoint restore are
+    bitwise-identical to a solo campaign run."""
+    from p2p_gossip_tpu import telemetry
+    from p2p_gossip_tpu.batch.campaign import (
+        flood_replicas,
+        run_coverage_campaign,
+    )
+    from p2p_gossip_tpu.serve.request import SimRequest
+    from p2p_gossip_tpu.serve.server import GossipServer
+
+    telemetry.configure(None, rings=False)
+    topo = {"family": "erdos_renyi", "n": 48, "p": 0.12, "seed": 4}
+    long_req = SimRequest.make(
+        topo, "flood", 2, 12, list(range(6)), request_id="longreq"
+    )
+    filler = SimRequest.make(
+        topo, "flood", 2, 12, [100, 101], request_id="filler"
+    )
+    ckdir = str(tmp_path / "serve-ck")
+
+    placements = []
+    orig_run = GossipServer._run_batch
+
+    def spy(self, plan):
+        placements.append([(u.request_id, u.replica) for u in plan.units])
+        return orig_run(self, plan)
+
+    monkeypatch.setattr(GossipServer, "_run_batch", spy)
+
+    srv = GossipServer(slots=4, checkpoint_dir=ckdir)
+    srv.submit(long_req)
+    srv.step()  # batch 0: replicas 0-3 occupy slots 0-3
+    assert placements[-1] == [("longreq", r) for r in range(4)]
+
+    # Evict at the batch boundary: replicas 4,5 leave the queue, the 4
+    # finished rows persist to the checkpoint dir.
+    assert srv.preempt("longreq") == 2
+    assert srv.status("longreq") == "preempted"
+    assert srv.step() is None  # nothing runnable while evicted
+
+    # A new request arrives, then the evicted one resumes *behind* it:
+    # its remaining replicas land in slot indices 2,3 — not the 0,1
+    # they would have had uninterrupted.
+    srv.submit(filler)
+    srv.resume("longreq")
+    srv.step()
+    assert placements[-1] == [
+        ("filler", 0), ("filler", 1), ("longreq", 4), ("longreq", 5),
+    ]
+    assert srv.status("longreq") == "done"
+
+    graph = srv._graph(long_req)
+    want = run_coverage_campaign(
+        graph, flood_replicas(graph, 2, list(range(6)), 12), 12
+    )
+    got = srv.result("longreq")
+    for f in ("generated", "received", "sent", "coverage"):
+        assert np.array_equal(getattr(got, f), getattr(want, f)), f
+
+    # Fresh server, same checkpoint dir, new request id but identical
+    # content: the partial (4/6 replicas, saved at preemption) restores
+    # by fingerprint and only the remainder runs — still bitwise equal.
+    srv2 = GossipServer(slots=4, checkpoint_dir=ckdir)
+    rid2 = srv2.submit(long_req.to_dict() | {"request_id": "longreq-v2"})
+    assert srv2._states[rid2].replicas_done == 4
+    assert srv2.drain() == 1
+    assert placements[-1] == [("longreq-v2", 4), ("longreq-v2", 5)]
+    got2 = srv2.result(rid2)
+    for f in ("generated", "received", "sent", "coverage"):
+        assert np.array_equal(getattr(got2, f), getattr(want, f)), f
+
+
 def test_atomic_savez_reclaims_dead_writer_tmps(tmp_path):
     """Orphan tmps from hard-killed writers (and the legacy stable-name
     scheme) are swept on the next save; a live concurrent writer's tmp

@@ -1,0 +1,361 @@
+"""Gossip-as-a-service (serve/): request model, slot scheduler,
+continuous-batching server parity, schema-v2 telemetry."""
+
+import json
+
+import numpy as np
+import pytest
+
+from p2p_gossip_tpu import telemetry
+from p2p_gossip_tpu.serve.request import (
+    SimRequest,
+    build_graph,
+    topology_fingerprint,
+    validate_request,
+)
+from p2p_gossip_tpu.serve.scheduler import (
+    SlotScheduler,
+    modeled_request_cost,
+)
+from p2p_gossip_tpu.telemetry import schema
+
+TOPO = {"family": "erdos_renyi", "n": 40, "p": 0.15, "seed": 2}
+TOPO_WS = {"family": "watts_strogatz", "n": 40, "k": 4, "beta": 0.1,
+           "seed": 3}
+
+
+def _req(rid, protocol="flood", seeds=(0, 1), topology=TOPO, **kw):
+    return SimRequest.make(
+        topology, protocol, 2, 10, seeds, request_id=rid, **kw
+    )
+
+
+# ---------------------------------------------------------------------------
+# Request model
+# ---------------------------------------------------------------------------
+
+def test_request_json_roundtrip():
+    req = _req("r1", protocol="pushk", fanout=3, loss_prob=0.1)
+    back = SimRequest.from_json(req.to_json())
+    assert back == req
+    assert back.replicas == 2
+    # dict form round-trips too, and a JSON submit parses the same.
+    assert SimRequest.from_dict(json.loads(req.to_json())) == req
+
+
+def test_request_validation_collects_errors():
+    bad = {
+        "request_id": "", "topology": {"family": "nope"},
+        "protocol": "carrier-pigeon", "shares": 0, "horizon": 1,
+        "seeds": [], "loss_prob": 2.0,
+    }
+    errs = validate_request(bad)
+    joined = "\n".join(errs)
+    for fragment in ("request_id", "family", "protocol", "shares",
+                     "seeds", "loss_prob"):
+        assert fragment in joined, fragment
+    # Never raises, whatever the input.
+    assert validate_request("not a dict")
+    assert validate_request({"topology": 7})
+    # Unknown topology parameter and missing required parameter.
+    assert validate_request(
+        _req("x").to_dict() | {"topology": {"family": "ring", "n": 8}}
+    ) == []
+    assert validate_request(
+        _req("x").to_dict() | {"topology": {"family": "ring", "n": 8,
+                                            "p": 0.1}}
+    )
+    assert validate_request(
+        _req("x").to_dict() | {"topology": {"family": "erdos_renyi",
+                                            "n": 8}}
+    )
+    with pytest.raises(ValueError):
+        SimRequest.make(TOPO, "flood", 0, 10, [1])
+
+
+def test_topology_fingerprint_is_param_order_invariant():
+    a = {"family": "erdos_renyi", "n": 40, "p": 0.15, "seed": 2}
+    b = {"seed": 2, "p": 0.15, "n": 40, "family": "erdos_renyi"}
+    assert topology_fingerprint(a) == topology_fingerprint(b)
+    assert topology_fingerprint(a) != topology_fingerprint(
+        dict(a, seed=3)
+    )
+    g = build_graph(a)
+    assert g.n == 40
+
+
+def test_static_signature_batching_rules():
+    # Seeds are traced operands: excluded from the signature by design.
+    assert _req("a", seeds=(0, 1)).static_signature() == \
+        _req("b", seeds=(7, 8, 9)).static_signature()
+    # fanout only pins pushk programs.
+    assert _req("a", fanout=2).static_signature() == \
+        _req("b", fanout=5).static_signature()
+    assert _req("a", protocol="pushk", fanout=2).static_signature() != \
+        _req("b", protocol="pushk", fanout=5).static_signature()
+    # Loss threshold and topology are static/shape config.
+    assert _req("a").static_signature() != \
+        _req("b", loss_prob=0.1).static_signature()
+    assert _req("a").static_signature() != \
+        _req("b", topology=TOPO_WS).static_signature()
+    # Churn off collapses to one signature arm.
+    assert _req("a").static_signature()[-1] is None
+    assert _req("a", churn_prob=0.1).static_signature()[-1] is not None
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: packing + admission
+# ---------------------------------------------------------------------------
+
+def test_scheduler_packs_same_signature_across_requests():
+    sched = SlotScheduler(slots=4)
+    sched.enqueue(_req("r1", seeds=(0, 1, 2)))
+    sched.enqueue(_req("r2", seeds=(3, 4)))
+    plan = sched.next_plan()
+    # FIFO across requests of one signature: r1's 3 units + r2's first.
+    assert [(u.request_id, u.replica) for u in plan.units] == [
+        ("r1", 0), ("r1", 1), ("r1", 2), ("r2", 0),
+    ]
+    assert plan.request_ids == ["r1", "r2"]
+    rest = sched.next_plan()
+    assert [(u.request_id, u.replica) for u in rest.units] == [("r2", 1)]
+    assert sched.next_plan() is None
+
+
+def test_scheduler_never_mixes_signatures():
+    sched = SlotScheduler(slots=8)
+    sched.enqueue(_req("f", seeds=(0,)))
+    sched.enqueue(_req("p", protocol="pushpull", seeds=(1,)))
+    sched.enqueue(_req("f2", seeds=(2,)))
+    first = sched.next_plan()
+    # Oldest unit owns the dispatch; only its signature rides along —
+    # f2 joins f, the pushpull unit waits for its own batch.
+    assert {u.request_id for u in first.units} == {"f", "f2"}
+    second = sched.next_plan()
+    assert {u.request_id for u in second.units} == {"p"}
+
+
+def test_scheduler_remove_drops_pending_units():
+    sched = SlotScheduler(slots=4)
+    sched.enqueue(_req("r1", seeds=(0, 1, 2)))
+    assert sched.remove("r1") == 3
+    assert sched.queue_depth() == 0
+    assert sched.next_plan() is None
+
+
+def test_modeled_cost_formula_and_admission():
+    req = _req("r", seeds=(0, 1, 2))
+    n, dmax = 40, 7
+    cost = modeled_request_cost(req, n, dmax)
+    w = 1  # shares=2 -> one uint32 word
+    entries = n * dmax
+    assert cost["bytes_per_tick"] == entries * (w * 4 + 4) + 6 * n * w * 4
+    assert cost["flops_per_tick"] == entries * w
+    assert cost["slot_bytes"] == cost["bytes_per_tick"] * req.horizon
+    assert cost["request_bytes"] == cost["slot_bytes"] * 3
+    sched = SlotScheduler(slots=4)
+    ok, _, reason = sched.admit(req, n, dmax)
+    assert ok and reason is None
+    ok, cost, reason = sched.admit(req, n, dmax, hbm_budget_bytes=100)
+    assert not ok and "HBM budget" in reason
+    ok, _, reason = sched.admit(req, n, dmax, max_request_bytes=10)
+    assert not ok and "per-request cap" in reason
+
+
+# ---------------------------------------------------------------------------
+# Server: drain parity, preemption, rejection, telemetry
+# ---------------------------------------------------------------------------
+
+def _solo_reference(graph, req):
+    from p2p_gossip_tpu.batch.campaign import (
+        flood_replicas,
+        run_coverage_campaign,
+        run_protocol_campaign,
+    )
+    from p2p_gossip_tpu.models.linkloss import LinkLossModel
+    from p2p_gossip_tpu.models.seeds import replica_loss_seeds
+
+    reps = flood_replicas(graph, req.shares, list(req.seeds), req.horizon)
+    loss = LinkLossModel(req.loss_prob) if req.loss_prob > 0 else None
+    lseeds = replica_loss_seeds(list(req.seeds)) if loss else None
+    if req.protocol == "flood":
+        return run_coverage_campaign(
+            graph, reps, req.horizon, loss=loss, loss_seeds=lseeds
+        )
+    return run_protocol_campaign(
+        graph, reps, req.horizon, protocol=req.protocol, fanout=req.fanout,
+        record_coverage=True, loss=loss, loss_seeds=lseeds,
+    )
+
+
+def _assert_bitwise(got, ref, label):
+    for f in ("generated", "received", "sent", "coverage"):
+        assert np.array_equal(
+            np.asarray(getattr(got, f)), np.asarray(getattr(ref, f))
+        ), f"{label}: {f}"
+
+
+def test_server_drain_mixed_trace_bitwise_parity(tmp_path):
+    """Mixed single-device trace: flood x2 (shared signature), lossy
+    flood, pushpull — drained through shared slots, every request
+    bitwise a solo campaign run with the same seeds."""
+    from p2p_gossip_tpu.serve.server import GossipServer
+
+    stream = tmp_path / "serve.jsonl"
+    telemetry.configure(str(stream), rings=False)
+    try:
+        srv = GossipServer(slots=4)
+        reqs = [
+            _req("f1", seeds=(0, 1, 2)),
+            _req("f2", seeds=(3, 4)),
+            _req("lossy", seeds=(5,), loss_prob=0.1),
+            _req("pp", protocol="pushpull", seeds=(6, 7)),
+        ]
+        for r in reqs:
+            srv.submit(r)
+        batches = srv.drain()
+        assert batches >= 3  # three signatures at least
+        # f1 + f2 share one signature: their 5 units packed 2 batches,
+        # not the 1-request-per-batch 4+ a naive server would run.
+        stats = srv.stats()
+        assert stats["done"] == 4 and stats["queue_depth"] == 0
+        assert 0 < srv.slot_occupancy() <= 1.0
+        for r in reqs:
+            _assert_bitwise(
+                srv.result(r.request_id), _solo_reference(srv._graph(r), r),
+                r.request_id,
+            )
+    finally:
+        telemetry.close()
+    # The stream is schema-v2 valid end to end, and the new event types
+    # actually showed up.
+    lines = stream.read_text().splitlines()
+    assert schema.validate_stream(lines) == []
+    events = [json.loads(ln) for ln in lines]
+    req_events = [e for e in events if e["type"] == "request"]
+    assert {e["event"] for e in req_events} >= {
+        "submitted", "admitted", "dispatched", "done",
+    }
+    slot_events = [e for e in events if e["type"] == "slot"]
+    assert len(slot_events) == batches
+    assert any(len(e["request_ids"]) > 1 for e in slot_events)
+    hb = [e for e in events if e["type"] == "progress"
+          and e.get("kernel") == "serve.server"]
+    assert hb and all(
+        isinstance(e["active_requests"], int)
+        and isinstance(e["queue_depth"], int) for e in hb
+    )
+
+
+def test_server_sharded_mesh_parity():
+    """Dispatching on the factorized slot mesh must not change any bit
+    vs the single-device solo reference."""
+    import jax
+
+    from p2p_gossip_tpu.parallel.mesh import make_slot_mesh
+    from p2p_gossip_tpu.serve.server import GossipServer
+
+    telemetry.configure(None, rings=False)
+    mesh = make_slot_mesh(4, devices=jax.devices("cpu"))
+    srv = GossipServer(slots=4, mesh=mesh)
+    reqs = [
+        _req("f", seeds=(0, 1, 2)),
+        _req("pp", protocol="pushpull", seeds=(3, 4)),
+    ]
+    for r in reqs:
+        srv.submit(r)
+    srv.drain()
+    for r in reqs:
+        _assert_bitwise(
+            srv.result(r.request_id), _solo_reference(srv._graph(r), r),
+            r.request_id,
+        )
+
+
+def test_server_slots_must_divide_over_replica_shards():
+    import jax
+
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+    from p2p_gossip_tpu.serve.server import GossipServer
+
+    mesh = make_mesh(2, devices=jax.devices("cpu"), replicas=4)
+    with pytest.raises(ValueError, match="replica shards"):
+        GossipServer(slots=6, mesh=mesh)
+
+
+def test_server_admission_rejects_oversized_request():
+    from p2p_gossip_tpu.serve.server import GossipServer
+
+    telemetry.configure(None, rings=False)
+    srv = GossipServer(slots=4, hbm_budget_bytes=10_000)
+    rid = srv.submit(_req("big", seeds=(0, 1)))
+    assert srv.status(rid) == "rejected"
+    with pytest.raises(ValueError, match="rejected"):
+        srv.result(rid)
+    # The rejection is a telemetry event with the modeled cost attached.
+    rej = [
+        e for e in telemetry.events()
+        if e.get("type") == "request" and e.get("event") == "rejected"
+    ]
+    assert rej and rej[-1]["cost"]["resident_bytes"] > 0
+    # Nothing queued: a drain is a no-op.
+    assert srv.drain() == 0
+
+
+def test_server_rejects_duplicate_request_id():
+    from p2p_gossip_tpu.serve.server import GossipServer
+
+    telemetry.configure(None, rings=False)
+    srv = GossipServer(slots=4)
+    srv.submit(_req("dup", seeds=(0,)))
+    with pytest.raises(ValueError, match="duplicate"):
+        srv.submit(_req("dup", seeds=(1,)))
+
+
+def test_schema_v2_request_slot_validators_and_v1_meta():
+    assert schema.SCHEMA_VERSION == 2
+    assert 1 in schema.SUPPORTED_SCHEMAS
+    # v1 streams stay valid under the v2 validator.
+    assert schema.validate_event({"type": "meta", "schema": 1,
+                                  "run": {}}) == []
+    ok_req = {
+        "type": "request", "request_id": "r", "event": "admitted",
+        "signature": "s", "replicas": 2, "replicas_done": 0,
+    }
+    assert schema.validate_event(ok_req) == []
+    assert schema.validate_event(dict(ok_req, event="vanished"))
+    assert schema.validate_event(dict(ok_req, request_id=""))
+    assert schema.validate_event(dict(ok_req, replicas=-1))
+    ok_slot = {
+        "type": "slot", "batch": 0, "signature": "s", "slots": 4,
+        "occupied": 2, "request_ids": ["a", "b"], "wall_s": 0.1,
+    }
+    assert schema.validate_event(ok_slot) == []
+    assert schema.validate_event(dict(ok_slot, occupied=9))  # > slots
+    assert schema.validate_event(dict(ok_slot, request_ids=[1]))
+    # Heartbeat extras are validated ints.
+    ok_hb = {"type": "progress", "kernel": "serve.server", "chunk": 1,
+             "elapsed_s": 0.5, "active_requests": 2, "queue_depth": 3}
+    assert schema.validate_event(ok_hb) == []
+    assert schema.validate_event(dict(ok_hb, queue_depth="lots"))
+
+
+def test_serve_compile_expectation_model():
+    """The sentinel's expected-compile model counts distinct static
+    signatures per kernel (the full replay runs in staticcheck's gate;
+    here the model itself is pinned)."""
+    from p2p_gossip_tpu.serve.server import GossipServer
+    from p2p_gossip_tpu.staticcheck.recompile import (
+        default_serve_trace,
+        expected_serve_compiles,
+    )
+
+    server = GossipServer(slots=4)
+    trace = [SimRequest.from_dict(d) for d in default_serve_trace()]
+    expected = expected_serve_compiles(trace, server)
+    # 2 topologies x flood + 1 lossy flood; 1 pushpull; 1 pushk; the
+    # while-loop kernel is never dispatched by the server.
+    assert expected == {
+        "coverage_batch": 3, "while_batch": 0,
+        "pushpull_replicas": 1, "pushk_replicas": 1,
+    }
