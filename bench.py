@@ -438,6 +438,34 @@ def main() -> None:
         except Exception as e:
             log(f"exchange report: did not complete ({type(e).__name__})")
 
+    # Degree-split hub/tail transport summary, distilled from the same
+    # exchange report (cost_report runs a hub leg per family): hub-set
+    # size, modeled vs achieved hub+tail words/tick, and which wire
+    # format won at bench scale. None whenever the exchange report is.
+    exchange_hub = None
+    if exchange and exchange.get("families"):
+        hub_rows = [
+            {
+                "family": fam.get("family"),
+                "hub_count": (fam.get("hub") or {}).get("hub_count"),
+                "crossover_h": (fam.get("hub") or {}).get("crossover_h"),
+                "modeled_hub_words_per_tick": (
+                    (fam.get("hub") or {}).get("modeled_hub_words_per_tick")
+                ),
+                "achieved_words_per_tick": (
+                    (fam.get("hub") or {})
+                    .get("achieved_delta_words_per_tick")
+                ),
+                "delta_over_hub": fam.get("delta_over_hub"),
+                "winner": fam.get("winner"),
+            }
+            for fam in exchange["families"] if fam.get("hub")
+        ]
+        if hub_rows:
+            exchange_hub = {
+                "platform": exchange.get("platform"), "families": hub_rows,
+            }
+
     # Campaigns x shards (batch/campaign_sharded.py): R replicas of the
     # node-sharded flood as ONE compiled program on a factorized
     # (replicas, nodes) mesh. The bench process can't re-fan its own
@@ -611,6 +639,9 @@ def main() -> None:
         # benchmark topology family (platform-labeled, see above); None
         # on smoke or when it could not run.
         "exchange": exchange,
+        # Hub/tail transport crossover per family (distilled from the
+        # exchange report's hub legs); None whenever ``exchange`` is.
+        "exchange_hub": exchange_hub,
         # One factorized (replicas, nodes)-mesh campaign row from the
         # rehearsal script's --replicas leg (platform-labeled "cpu",
         # bitwise-checked per replica); None on smoke or when it could

@@ -174,6 +174,8 @@ def run_sharded_campaign(
     bucket_min_rows: int = 2048,
     exchange: str = "dense",
     async_k: int = 2,
+    hub_rows: int | None = None,
+    aux_cache: tuple | None = None,
 ) -> CampaignResult:
     """Seed-ensemble flood campaign over a factorized (replicas, nodes)
     mesh: R replicas of the node-sharded flood engine in one jitted
@@ -186,13 +188,16 @@ def run_sharded_campaign(
     contract: a shared `LinkLossModel` gives every replica the model's
     own seed; ``loss_seeds`` (one per replica,
     `models.seeds.replica_loss_seeds`) gives independent erasure streams.
-    ``exchange`` "dense"/"delta"/"auto" resolves like run_sharded_sim —
-    the delta capacity is planned once from the shared partition edge cut
-    and reused by every replica — and the async spellings
-    ("async"/"async-dense"/"async-delta" with ``async_k`` = K) switch
-    every replica to the bounded-staleness read path, exactly as
-    `run_sharded_sim` does (replica r stays bitwise its solo async run,
-    i.e. its sync run with cross-shard delays clamped to max(d, K)).
+    ``exchange`` "dense"/"delta"/"auto"/"hub" resolves like
+    run_sharded_sim — the delta capacity (and under "hub" the
+    fan-ranked degree split, with ``hub_rows`` pinning the hub size and
+    ``aux_cache`` persisting the cut scan) is planned once from the
+    shared partition edge cut and reused by every replica — and the
+    async spellings ("async"/"async-dense"/"async-delta"/"async-hub"
+    with ``async_k`` = K) switch every replica to the bounded-staleness
+    read path, exactly as `run_sharded_sim` does (replica r stays
+    bitwise its solo async run, i.e. its sync run with cross-shard
+    delays clamped to max(d, K)).
     Resolved ring/exchange reports land in ``result.extra``."""
     from p2p_gossip_tpu.parallel.engine_sharded import (
         _resolve_and_stage_ring,
@@ -221,15 +226,18 @@ def run_sharded_campaign(
         ring_mode, uniform, ring, n_padded, n_node_shards,
         bitmask.num_words(chunk), ell_idx, ell_delay, ell_mask,
         block=block, bucket_min_rows=bucket_min_rows, exchange=exchange,
+        hub_rows=hub_rows, aux_cache=aux_cache,
     )
-    exchange_mode, need, capacity, exchange_extra = exchange_plan
-    delta_on = exchange_mode == "delta"
+    (exchange_mode, need, capacity, exchange_extra, hub_ops,
+     aggregate) = exchange_plan
+    delta_on = exchange_mode in ("delta", "hub")
+    hub_n = hub_ops[0] if hub_ops else 0
     if k_async:
         exchange_extra.update(async_ticks.modeled_overlap_report(
             exchange_mode,
             (uniform,) if uniform is not None else delay_values,
             k_async, n_node_shards, n_padded // n_node_shards,
-            bitmask.num_words(chunk), capacity,
+            bitmask.num_words(chunk), capacity, hub_count=hub_n,
         ))
 
     loss_cfg, lseed_arr = _resolve_loss(loss, loss_seeds, r_total)
@@ -244,6 +252,7 @@ def run_sharded_campaign(
         ring_mode=ring_mode, delay_values=delay_values,
         bucket_counts=bucket_counts, telemetry_on=tel,
         exchange_mode=exchange_mode, delta_capacity=capacity,
+        hub_count=hub_n, delta_aggregate=aggregate,
         replica_axis=REPLICAS_AXIS, local_replicas=rb,
         per_replica_loss=(loss is not None),
         async_k=k_async,
@@ -311,6 +320,8 @@ def run_sharded_campaign(
             args = args + (lseeds_b,)
         if delta_on:
             args = args + (need,)
+        if hub_ops:
+            args = args + (hub_ops[1], hub_ops[2])
         with telemetry.span(
             "dispatch",
             kernel="parallel.engine_sharded.flood_runner[campaign]",
@@ -374,6 +385,7 @@ def run_sharded_campaign(
         extra["exchange"] = _achieved_exchange_report(
             exchange_extra, exch_counters, exch_ticks, n_node_shards,
             n_padded // n_node_shards, bitmask.num_words(chunk), capacity,
+            hub_count=hub_n,
         )
     else:
         extra["exchange"] = exchange_extra
@@ -413,6 +425,7 @@ def run_sharded_protocol_campaign(
     ring_mode: str = "auto",
     exchange: str = "dense",
     async_k: int = 2,
+    hub_rows: int | None = None,
 ) -> CampaignResult:
     """Seed-ensemble random-partner campaign over the factorized mesh:
     the campaign counterpart of `run_sharded_partnered_sim`, replica
@@ -420,15 +433,15 @@ def run_sharded_protocol_campaign(
     counter-based hash takes the seed as data, so one compiled program
     serves every seed). Replica r is bitwise its solo partnered run with
     ``seed=replicas.seeds[r]``, including under the async exchange
-    spellings (``exchange``/``async_k`` follow
+    spellings (``exchange``/``async_k``/``hub_rows`` follow
     `run_sharded_partnered_sim`: anti-entropy only, delays clamped
-    host-side to max(d, K))."""
-    from p2p_gossip_tpu.parallel import exchange as exch_mod
+    host-side to max(d, K); "hub" plans the degree split once and every
+    replica shares it)."""
     from p2p_gossip_tpu.parallel.engine_sharded import (
         _padded_device_graph,
-        resolve_ring_mode,
     )
     from p2p_gossip_tpu.parallel.protocols_sharded import (
+        _resolve_partnered_exchange,
         build_partnered_runner,
     )
 
@@ -474,67 +487,19 @@ def run_sharded_protocol_campaign(
     else:
         stale_values, stale_amounts = (), ()
 
-    # Ring + exchange resolution mirrors run_sharded_partnered_sim.
-    if exchange not in ("dense", "delta", "auto"):
-        raise ValueError(f"unknown exchange mode {exchange!r}")
-    anti = protocol in ("pushpull", "pull")
-    if exchange == "delta" and anti:
-        ring_mode = "sharded"
-    distinct = tuple(int(v) for v in np.unique(ell_delay))
-    if ring_mode == "auto" and protocol == "pushk":
-        ring_mode = "sharded"
-    ring_mode, ring_bytes = resolve_ring_mode(
-        ring_mode, distinct[0] if len(distinct) == 1 else None,
-        ring, n_padded, n_node_shards, bitmask.num_words(chunk),
-    )
-    delay_values = distinct if ring_mode == "sharded" and anti else None
-    if exchange == "auto":
-        exchange = (
-            "delta"
-            if anti and ring_mode == "sharded" and n_node_shards > 1
-            else "dense"
-        )
-    delta_on = exchange == "delta" and anti and ring_mode == "sharded"
+    # Ring + exchange resolution shared with run_sharded_partnered_sim
+    # (including the "hub" degree split — planned once, shared by every
+    # replica: the split depends only on the graph, not the seed).
     w = bitmask.num_words(chunk)
-    n_loc = n_padded // n_node_shards
-    # Worst case every local row changes — the anti-entropy delta has no
-    # static cut to restrict it (partners are global-random).
-    capacity = (
-        exch_mod.delta_capacity(n_loc, n_loc, w, len(delay_values))
-        if delta_on else 0
-    )
-    dense_kind = (
-        ("dense" if anti else "none")
-        if ring_mode == "sharded" else "replicated"
-    )
-    exchange_extra = {
-        "mode": "delta" if delta_on else dense_kind,
-        "capacity": capacity,
-        "modeled_dense_words_per_tick": (
-            exch_mod.modeled_exchange_words_per_tick(
-                dense_kind, n_shards=n_node_shards, n_loc=n_loc, w=w,
-                delay_splits=len(delay_values) if delay_values else 1,
-            )
-        ),
-    }
-    if delta_on:
-        exchange_extra["modeled_delta_words_per_tick"] = (
-            exch_mod.modeled_exchange_words_per_tick(
-                "delta", n_shards=n_node_shards, n_loc=n_loc, w=w,
-                capacity=capacity,
-            )
+    (ring_mode, ring_bytes, delay_values, exchange, capacity, hub_ops,
+     aggregate, delta_on, exchange_extra, async_staleness) = (
+        _resolve_partnered_exchange(
+            exchange, protocol, ring_mode, ell_delay, ring, n_padded,
+            n_node_shards, w, degree, k_async, stale_values,
+            stale_amounts, hub_rows,
         )
-    if k_async:
-        exchange_extra.update(async_ticks.modeled_overlap_report(
-            "delta" if delta_on else "dense",
-            delay_values, k_async, n_node_shards, n_loc, w, capacity,
-        ))
-        exchange_extra["staleness_amounts"] = list(stale_amounts)
-    amounts_by_value = dict(zip(stale_values, stale_amounts))
-    async_staleness = (
-        tuple(amounts_by_value.get(v, 0) for v in delay_values)
-        if k_async else ()
     )
+    n_loc = n_padded // n_node_shards
 
     loss_cfg, lseed_arr = _resolve_loss(loss, loss_seeds, r_total)
     static_loss, lseed_arr = _campaign_loss_seeds(loss_cfg, lseed_arr, r_total)
@@ -545,8 +510,10 @@ def run_sharded_protocol_campaign(
         fanout if protocol == "pushk" else 1,
         static_loss, record_coverage,
         ring_mode=ring_mode, delay_values=delay_values, telemetry_on=tel,
-        exchange_mode="delta" if delta_on else "dense",
+        exchange_mode=exchange if delta_on else "dense",
         delta_capacity=capacity,
+        hub_count=hub_ops[0] if hub_ops else 0,
+        delta_aggregate=aggregate,
         replica_axis=REPLICAS_AXIS, local_replicas=rb,
         per_replica_loss=(loss is not None),
         async_k=k_async, async_staleness=async_staleness,
@@ -605,6 +572,8 @@ def run_sharded_protocol_campaign(
                 seeds_b)
         if loss is not None:
             args = args + (lseeds_b,)
+        if hub_ops:
+            args = args + (hub_ops[1], hub_ops[2], hub_ops[3])
         with telemetry.span(
             "dispatch",
             kernel=f"parallel.protocols_sharded.{protocol}_runner[campaign]",
@@ -658,6 +627,7 @@ def run_sharded_protocol_campaign(
         exchange_extra = _achieved_exchange_report(
             exchange_extra, exch_counters, exch_ticks,
             n_node_shards, n_loc, w, capacity,
+            hub_count=hub_ops[0] if hub_ops else 0,
         )
     extra = {
         "ring": {

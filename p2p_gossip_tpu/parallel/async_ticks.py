@@ -58,7 +58,7 @@ import numpy as np
 
 #: Exchange-mode spellings accepted by the sharded drivers on top of the
 #: synchronous "dense"/"delta"/"auto" trio.
-ASYNC_EXCHANGES = ("async", "async-dense", "async-delta")
+ASYNC_EXCHANGES = ("async", "async-dense", "async-delta", "async-hub")
 
 
 def parse_exchange(exchange: str, async_k: int) -> tuple[str, int]:
@@ -71,10 +71,10 @@ def parse_exchange(exchange: str, async_k: int) -> tuple[str, int]:
     is the synchronous program routed through the double-buffer (the
     bitwise anchor of the parity ladder)."""
     if exchange not in ASYNC_EXCHANGES:
-        if exchange not in ("dense", "delta", "auto"):
+        if exchange not in ("dense", "delta", "auto", "hub"):
             raise ValueError(
                 f"unknown exchange mode {exchange!r} (valid: dense, delta, "
-                f"auto, {', '.join(ASYNC_EXCHANGES)})"
+                f"auto, hub, {', '.join(ASYNC_EXCHANGES)})"
             )
         return exchange, 0
     if async_k < 1:
@@ -83,6 +83,7 @@ def parse_exchange(exchange: str, async_k: int) -> tuple[str, int]:
         )
     transport = {
         "async": "auto", "async-dense": "dense", "async-delta": "delta",
+        "async-hub": "hub",
     }[exchange]
     return transport, int(async_k)
 
@@ -246,6 +247,7 @@ def modeled_overlap_report(
     n_loc: int,
     w: int,
     capacity: int = 0,
+    hub_count: int = 0,
 ) -> dict:
     """The ``stats.extra['exchange']`` async fields, priced against the
     shared traffic model (exchange.modeled_exchange_words_per_tick):
@@ -258,10 +260,12 @@ def modeled_overlap_report(
     offs, off_index, amounts = group_offsets(group_delays, async_k)
     k1 = max(0, n_shards - 1)
     blocking_groups = sum(1 for i in off_index if i < 0)
-    if transport == "delta":
-        # The fixed all_to_all footprint is written >= 2 ticks before its
-        # first async reader; only dense fallbacks on direct groups block.
-        prefetch = k1 * 2 * capacity
+    if transport in ("delta", "hub"):
+        # The fixed all_to_all footprint — plus the hub block's
+        # all_gather under exchange="hub" — is written >= 2 ticks before
+        # its first async reader; only dense fallbacks on direct groups
+        # block.
+        prefetch = k1 * (2 * capacity + hub_count * w)
         blocking = 0
     else:
         prefetch = len(offs) * k1 * n_loc * w

@@ -221,6 +221,8 @@ def _resolve_and_stage_ring(
     block: int = DEFAULT_DEGREE_BLOCK,
     bucket_min_rows: int = 2048,
     exchange: str = "dense",
+    hub_rows: int | None = None,
+    aux_cache: tuple | None = None,
 ):
     """Resolve the ring layout and stage its operands in one step — the
     shared stanza of both sharded entry points. Returns (ring_mode,
@@ -228,15 +230,23 @@ def _resolve_and_stage_ring(
     where ``ring_extra`` is the ``stats.extra['ring']`` report dict,
     ``bucket_counts`` is the static per-group bucket layout the runner
     unflattens ``ell_args`` by, and ``exchange_plan`` is the resolved
-    frontier-exchange path: ``(mode, need, capacity, extra)`` — mode
-    "dense" (slice all_gathers) or "delta" (sparse frontier-delta
-    buffers over the cached cut structure, parallel/exchange.py), with
-    ``need`` the (n_padded, n_shards) cut membership to stage and
-    ``extra`` the ``stats.extra['exchange']`` report dict."""
-    if exchange not in ("dense", "delta", "auto"):
+    frontier-exchange path:
+    ``(mode, need, capacity, extra, hub_ops, aggregate)`` — mode
+    "dense" (slice all_gathers), "delta" (sparse frontier-delta buffers
+    over the cached cut structure, parallel/exchange.py), or "hub"
+    (degree-split hub/tail transport: `exchange.plan_hub_split`), with
+    ``need`` the (n_padded, n_shards) cut membership to stage (hub rows
+    cleared under "hub"), ``hub_ops`` None or the
+    ``(hub_count, hub_local, hub_global)`` operand triple, ``aggregate``
+    the host-chosen `compress_deltas` packing (`choose_aggregate`), and
+    ``extra`` the ``stats.extra['exchange']`` report dict. ``hub_rows``
+    pins the hub size (tests; graphs where the cost search picks 0) and
+    ``aux_cache`` is `exchange.cached_flood_plan`'s (path, fp, key)
+    persistence triple for the cut structure."""
+    if exchange not in ("dense", "delta", "auto", "hub"):
         raise ValueError(f"unknown exchange mode {exchange!r}")
-    if exchange == "delta":
-        # The delta path compresses the sharded ring's write slices;
+    if exchange in ("delta", "hub"):
+        # The delta/hub paths compress the sharded ring's write slices;
         # a replicated ring has no read-time exchange to compress.
         ring_mode = "sharded"
     ring_mode, ring_bytes = resolve_ring_mode(
@@ -261,31 +271,63 @@ def _resolve_and_stage_ring(
         "degree_buckets": bucket_counts,
     }
     n_loc = n_padded // n_node_shards
-    if exchange == "delta":
+    if exchange in ("delta", "hub"):
         from p2p_gossip_tpu.parallel import exchange as exch
 
-        need, need_counts = exch.plan_flood_exchange(
-            ell_idx, ell_mask, n_node_shards
+        need, need_counts = exch.cached_flood_plan(
+            ell_idx, ell_mask, n_node_shards, aux_cache=aux_cache
         )
-        capacity = exch.delta_capacity(
-            int(need_counts.max()) if need_counts.size else 1,
-            n_loc, w, delay_splits,
-        )
+        max_cut = int(need_counts.max()) if need_counts.size else 0
+        hub_ops = None
+        hub_report = None
+        if exchange == "hub":
+            hplan = exch.plan_hub_split(
+                need, need_counts, n_node_shards, n_loc, w,
+                delay_splits, hub_rows=hub_rows,
+            )
+            hub_report = hplan["report"]
+            need = hplan["need_tail"]
+            capacity = hplan["capacity"]
+            if hplan["hub_count"] > 0:
+                hub_ops = (
+                    hplan["hub_count"], hplan["hub_local"],
+                    hplan["hub_global"],
+                )
+        else:
+            capacity = exch.delta_capacity(
+                max(max_cut, 1), n_loc, w, delay_splits,
+            )
+        aggregate = exch.choose_aggregate(n_node_shards, capacity)
         exchange_extra = {
-            "mode": "delta",
+            "mode": exchange,
             "capacity": capacity,
-            "max_cut_rows": int(need_counts.max()) if need_counts.size else 0,
+            "aggregated": aggregate,
+            "max_cut_rows": max_cut,
             "modeled_dense_words_per_tick": exch.modeled_exchange_words_per_tick(
                 "dense" if ring_mode == "sharded" else "replicated",
                 n_shards=n_node_shards, n_loc=n_loc, w=w,
                 delay_splits=delay_splits,
             ),
-            "modeled_delta_words_per_tick": exch.modeled_exchange_words_per_tick(
-                "delta", n_shards=n_node_shards, n_loc=n_loc, w=w,
-                capacity=capacity,
+            "modeled_delta_words_per_tick": (
+                hub_report["modeled_delta_words_per_tick"]
+                if hub_report is not None
+                else exch.modeled_exchange_words_per_tick(
+                    "delta", n_shards=n_node_shards, n_loc=n_loc, w=w,
+                    capacity=capacity,
+                )
             ),
         }
-        exchange_plan = ("delta", need, capacity, exchange_extra)
+        if hub_report is not None:
+            exchange_extra.update({
+                "hub_count": hub_report["hub_count"],
+                "hub_rows_forced": hub_report["hub_rows_forced"],
+                "crossover_h": hub_report["crossover_h"],
+                "modeled_hub_words_per_tick":
+                    hub_report["modeled_hub_words_per_tick"],
+            })
+        exchange_plan = (
+            exchange, need, capacity, exchange_extra, hub_ops, aggregate,
+        )
     else:
         from p2p_gossip_tpu.parallel import exchange as exch
 
@@ -297,7 +339,7 @@ def _resolve_and_stage_ring(
                 mode, n_shards=n_node_shards, n_loc=n_loc, w=w,
                 delay_splits=delay_splits,
             ),
-        })
+        }, None, False)
     return (
         ring_mode, ell_args, delay_values, bucket_counts, ring_extra,
         exchange_plan,
@@ -312,11 +354,13 @@ def _achieved_exchange_report(
     n_loc: int,
     w: int,
     capacity: int,
+    hub_count: int = 0,
 ) -> dict:
     """Fold the delta runner's achieved-traffic counters into the
     ``stats.extra['exchange']`` report: used entries / overflow writes /
     dense fallbacks summed over passes and share shards, plus the
-    achieved per-chip per-tick wire words (fixed all_to_all footprint +
+    achieved per-chip per-tick wire words (fixed all_to_all footprint,
+    plus the fixed hub all_gather block under ``exchange="hub"``, +
     amortized dense fallbacks) and the steady-state buffer occupancy —
     used entries over the wire-relevant slot count."""
     k = n_shards
@@ -327,7 +371,7 @@ def _achieved_exchange_report(
     extra["exchange_ticks"] = int(ticks)
     if ticks:
         extra["achieved_delta_words_per_tick"] = (
-            (k - 1) * 2 * capacity
+            (k - 1) * (2 * capacity + hub_count * w)
             + int(counters[2]) * (k - 1) * n_loc * w / ticks
         )
         extra["delta_occupancy"] = int(counters[0]) / (
@@ -457,6 +501,8 @@ def build_sharded_runner(
     telemetry_on: bool = False,
     exchange_mode: str = "dense",
     delta_capacity: int = 0,
+    hub_count: int = 0,
+    delta_aggregate: bool = False,
     replica_axis: str | None = None,
     local_replicas: int = 1,
     per_replica_loss: bool = False,
@@ -570,9 +616,15 @@ def build_sharded_runner(
     cov_w = bitmask.num_words(cov_slots)
     sharded_ring = ring_mode == "sharded"
     hist_rows = n_loc if sharded_ring else n_padded
-    delta = exchange_mode == "delta"
+    # "hub" is the delta transport plus a static index-free hub block;
+    # hub_count == 0 (the cost search picked pure delta) compiles the
+    # plain delta program — no zero-size hub collectives.
+    delta = exchange_mode in ("delta", "hub")
+    hub = exchange_mode == "hub" and hub_count > 0
     if delta and not sharded_ring:
-        raise ValueError("exchange_mode='delta' requires ring_mode='sharded'")
+        raise ValueError(
+            f"exchange_mode={exchange_mode!r} requires ring_mode='sharded'"
+        )
     if delta and delta_capacity < 1:
         raise ValueError(f"delta_capacity must be >= 1, got {delta_capacity}")
     if delta:
@@ -661,6 +713,12 @@ def build_sharded_runner(
         ex_i = 7 + (1 if tel else 0) + (1 if dig else 0)
         if delta:
             need = delta_args[0]  # (n_loc, n_shards) cut membership
+            if hub:
+                # Static hub membership (plan_hub_split): this shard's
+                # local hub row ids (leading shard axis sliced to row 0)
+                # and the replicated global ids of every shard's block.
+                hub_rows_l = delta_args[1][0]
+                hub_global = delta_args[2]
             rstate = rstate + (
                 # Received-delta rings, slot-aligned with hist: axis 1 is
                 # the SOURCE shard post all_to_all. idx -1 = empty.
@@ -679,8 +737,19 @@ def build_sharded_runner(
                 #  exchange_ticks, 0, 0, 0]
                 jnp.zeros((8,), dtype=jnp.uint32),
             )
+        if hub:
+            # Hub block ring, slot-aligned with hist: every shard's h
+            # hub rows at the written tick, all_gathered at write time.
+            # Unwritten slots stay zero, so overlaying them is a no-op.
+            rstate = rstate + (
+                jnp.zeros(
+                    (ring_size, n_node_shards * hub_count, w),
+                    dtype=jnp.uint32,
+                ),
+            )
         landed_i = (
-            7 + (1 if tel else 0) + (1 if dig else 0) + (4 if delta else 0)
+            7 + (1 if tel else 0) + (1 if dig else 0)
+            + (4 if delta else 0) + (1 if hub else 0)
         )
         if n_offs:
             # Async landed double-buffer: one prefetched full-canvas
@@ -733,7 +802,7 @@ def build_sharded_runner(
                 return sl
             if not delta:
                 return lax.all_gather(sl, NODES_AXIS, axis=0, tiled=True)
-            didx_ring, dval_ring, dflag_ring = dstate
+            didx_ring, dval_ring, dflag_ring = dstate[:3]
 
             def dense_read(_):
                 return lax.all_gather(sl, NODES_AXIS, axis=0, tiled=True)
@@ -742,6 +811,13 @@ def build_sharded_runner(
                 recon = exch.scatter_deltas(
                     didx_ring[slot], dval_ring[slot], n_loc, w, n_padded
                 )
+                if hub:
+                    # Hub rows never ride the tail buffers (the plan
+                    # clears them from the cut): overlay the slot's
+                    # gathered hub block — disjoint rows, exact .set.
+                    recon = exch.overlay_hub(
+                        recon, hub_global, dstate[3][slot]
+                    )
                 # Own rows never ride the wire (plan_flood_exchange
                 # excludes them): overlay the local slice directly.
                 return lax.dynamic_update_slice(recon, sl, (row_offset, 0))
@@ -771,16 +847,24 @@ def build_sharded_runner(
                         lax.all_gather(sl, NODES_AXIS, axis=0, tiled=True)
                     )
                     continue
-                didx_ring, dval_ring, dflag_ring = dstate
+                didx_ring, dval_ring, dflag_ring = dstate[:3]
 
                 def dense_pre(_, sl=sl):
                     return lax.all_gather(sl, NODES_AXIS, axis=0, tiled=True)
 
                 def delta_pre(_, slot_u=slot_u):
-                    return exch.scatter_deltas(
+                    recon = exch.scatter_deltas(
                         didx_ring[slot_u], dval_ring[slot_u], n_loc, w,
                         n_padded,
                     )
+                    if hub:
+                        # Same overlay as delta_read; own hub rows get
+                        # their written-slot values, then the reader's
+                        # timely own-slice overlay wins (arrivals_for).
+                        recon = exch.overlay_hub(
+                            recon, hub_global, dstate[3][slot_u]
+                        )
+                    return recon
 
                 slices.append(lax.cond(
                     dflag_ring[slot_u], dense_pre, delta_pre, operand=None
@@ -873,7 +957,10 @@ def build_sharded_runner(
             landed = rstate[landed_i] if n_offs else None
             if delta:
                 didx_ring, dval_ring, dflag_ring, ectr = rstate[ex_i:ex_i + 4]
-                dstate = (didx_ring, dval_ring, dflag_ring)
+                hub_ring = rstate[ex_i + 4] if hub else None
+                dstate = (didx_ring, dval_ring, dflag_ring) + (
+                    (hub_ring,) if hub else ()
+                )
                 # Dense fallbacks this tick: one per read slot carrying
                 # the (mesh-uniform) overflow flag — per landed offset
                 # plus per direct-read group under async, per delay
@@ -960,7 +1047,8 @@ def build_sharded_runner(
                 # buffer anywhere on the mesh raises the slot's uniform
                 # overflow flag so every reader takes the dense branch.
                 cidx, cval, ccounts = exch.compress_deltas(
-                    newly_out, need, delta_capacity
+                    newly_out, need, delta_capacity,
+                    aggregate=delta_aggregate,
                 )
                 idx_recv = lax.all_to_all(
                     cidx, NODES_AXIS, split_axis=0, concat_axis=0
@@ -976,6 +1064,16 @@ def build_sharded_runner(
                 didx_ring = didx_ring.at[slot_w].set(idx_recv)
                 dval_ring = dval_ring.at[slot_w].set(val_recv)
                 dflag_ring = dflag_ring.at[slot_w].set(ovf)
+                if hub:
+                    # Index-free hub exchange: every shard's h hub rows
+                    # ride one tiled all_gather per tick — w words per
+                    # row per peer, no (idx, val) overhead, no overflow
+                    # (the block is exactly sized).
+                    hub_all = lax.all_gather(
+                        newly_out[hub_rows_l], NODES_AXIS, axis=0,
+                        tiled=True,
+                    )
+                    hub_ring = hub_ring.at[slot_w].set(hub_all)
                 # Achieved-traffic counters (uniform within the share
                 # shard): entries actually shipped mesh-wide this tick,
                 # overflow write ticks, dense fallback reads, ticks.
@@ -1009,7 +1107,10 @@ def build_sharded_runner(
                 # chunk, like the other columns.
                 if delta:
                     ex_words = (
-                        jnp.uint32((n_node_shards - 1) * 2 * delta_capacity)
+                        jnp.uint32(
+                            (n_node_shards - 1)
+                            * (2 * delta_capacity + hub_count * w)
+                        )
                         + fb_t * jnp.uint32((n_node_shards - 1) * n_loc * w)
                     )
                 elif sharded_ring:
@@ -1065,6 +1166,8 @@ def build_sharded_runner(
                 out = out + (tel_digest.write(rstate[dig_i], t, dval),)
             if delta:
                 out = out + (didx_ring, dval_ring, dflag_ring, ectr)
+            if hub:
+                out = out + (hub_ring,)
             if n_offs:
                 out = out + (landed_next,)
             return out
@@ -1174,6 +1277,11 @@ def build_sharded_runner(
             in_specs = in_specs + (P(replica_axis),)  # loss seeds (R,)
         if delta:
             in_specs = in_specs + (P(NODES_AXIS, None),)  # cut membership
+        if hub:
+            in_specs = in_specs + (
+                P(NODES_AXIS, None),  # hub_local (k, h) row ids
+                P(None, None),        # hub_global (k, h), replicated
+            )
         out_specs: tuple = (
             P(replica_axis, NODES_AXIS),        # received (R, n_padded)
             P(replica_axis, NODES_AXIS),        # sent
@@ -1197,7 +1305,11 @@ def build_sharded_runner(
             P(),                  # t_start
             P(),                  # last_gen
             P(),                  # snap_ticks
-        ) + ((P(NODES_AXIS, None),) if delta else ())  # cut membership
+        ) + (
+            ((P(NODES_AXIS, None),) if delta else ())  # cut membership
+            # hub_local (k, h) row ids + replicated hub_global (k, h).
+            + ((P(NODES_AXIS, None), P(None, None)) if hub else ())
+        )
         out_specs = (
             P(NODES_AXIS), P(NODES_AXIS), P(None, NODES_AXIS),
             P(None, SHARES_AXIS),
@@ -1281,13 +1393,18 @@ def _audit_spec_flood_runner(
         mesh.shape[NODES_AXIS],
         bitmask.num_words(chunk), ell_idx, ell_delay, ell_mask, block=block,
         exchange=exchange,
+        # The tiny ER audit graph has no natural hubs — pin h so the
+        # hub collectives and overlays actually trace.
+        hub_rows=(8 if exchange == "hub" else None),
     )
-    exchange_mode, need, capacity, _ = exchange_plan
+    exchange_mode, need, capacity, _, hub_ops, aggregate = exchange_plan
     runner, pass_size = build_sharded_runner(
         mesh, n_padded, ring, chunk, horizon, block, uniform, 0, None,
         ring_mode=ring_mode, delay_values=delay_values,
         bucket_counts=bucket_counts, telemetry_on=telemetry_on,
         exchange_mode=exchange_mode, delta_capacity=capacity,
+        hub_count=(hub_ops[0] if hub_ops else 0),
+        delta_aggregate=aggregate,
         replica_axis=(REPLICAS_AXIS if campaign else None),
         local_replicas=(local_replicas if campaign else 1),
         async_k=async_k,
@@ -1311,10 +1428,13 @@ def _audit_spec_flood_runner(
         ell_args, degree, churn_start, churn_end, origins, gen_ticks,
         np.int32(0), np.int32(0), np.zeros((0,), dtype=np.int32),
     )
-    if exchange_mode == "delta":
+    if exchange_mode in ("delta", "hub"):
         args = args + (need,)
         # Delta buffers (capacity minor dim) and the (1, 8) counter row.
         words = words + (capacity, 8)
+        if hub_ops:
+            args = args + (hub_ops[1], hub_ops[2])
+            words = words + (hub_ops[0],)
     return AuditSpec(
         fn=runner,
         args=args,
@@ -1353,6 +1473,18 @@ register_entry(
     "parallel.engine_sharded.flood_runner[async-delta]",
     spec=lambda: _audit_spec_flood_runner(exchange="delta", async_k=2),
 )
+register_entry(
+    "parallel.engine_sharded.flood_runner[hub]",
+    spec=lambda: _audit_spec_flood_runner(exchange="hub"),
+)
+register_entry(
+    "parallel.engine_sharded.flood_runner[campaign-hub]",
+    spec=lambda: _audit_spec_flood_runner(exchange="hub", campaign=True),
+)
+register_entry(
+    "parallel.engine_sharded.flood_runner[async-hub]",
+    spec=lambda: _audit_spec_flood_runner(exchange="hub", async_k=2),
+)
 
 
 def run_sharded_sim(
@@ -1375,6 +1507,8 @@ def run_sharded_sim(
     bucket_min_rows: int = 2048,
     exchange: str = "dense",
     async_k: int = 2,
+    hub_rows: int | None = None,
+    aux_cache: tuple | None = None,
 ) -> NodeStats:
     """Drop-in counterpart of run_sync_sim/run_event_sim on a device mesh:
     identical per-node counters, any number of shares — including under a
@@ -1406,9 +1540,14 @@ def run_sharded_sim(
     ``exchange`` selects the cross-shard frontier exchange: "dense"
     (slice all_gathers, the default), "delta" (sparse frontier-delta
     buffers over the cached cut structure — forces the sharded ring,
-    bitwise-identical counters), or "auto" (delta whenever the ring is
-    sharded across >1 node shards). The resolved path, its modeled
-    per-tick traffic, and the achieved counters land in
+    bitwise-identical counters), "hub" (the delta transport with a
+    static high-fan-out hub block shipped index-free every tick,
+    `exchange.plan_hub_split` — also sharded, also bitwise-identical;
+    ``hub_rows`` pins the split size, ``aux_cache`` persists the cut
+    structure through the graph's npz aux cache), or "auto" (delta
+    whenever the ring is sharded across >1 node shards). The resolved
+    path, its modeled per-tick traffic, the host-chosen delta packing
+    (``aggregated``), and the achieved counters land in
     ``stats.extra['exchange']``.
 
     ``exchange`` "async" / "async-dense" / "async-delta" switch to the
@@ -1440,16 +1579,19 @@ def run_sharded_sim(
         ring_mode, uniform, ring, n_padded, mesh.shape[NODES_AXIS],
         bitmask.num_words(chunk_size), ell_idx, ell_delay, ell_mask,
         block=block, bucket_min_rows=bucket_min_rows, exchange=exchange,
+        hub_rows=hub_rows, aux_cache=aux_cache,
     )
-    exchange_mode, need, capacity, exchange_extra = exchange_plan
-    delta_on = exchange_mode == "delta"
+    (exchange_mode, need, capacity, exchange_extra, hub_ops,
+     aggregate) = exchange_plan
+    delta_on = exchange_mode in ("delta", "hub")
+    hub_n = hub_ops[0] if hub_ops else 0
     if k_async:
         exchange_extra.update(async_ticks.modeled_overlap_report(
             exchange_mode,
             (uniform,) if uniform is not None else delay_values,
             k_async, mesh.shape[NODES_AXIS],
             n_padded // mesh.shape[NODES_AXIS],
-            bitmask.num_words(chunk_size), capacity,
+            bitmask.num_words(chunk_size), capacity, hub_count=hub_n,
         ))
     tel = telemetry.rings_enabled()
     runner, pass_size = build_sharded_runner(
@@ -1459,7 +1601,8 @@ def run_sharded_sim(
         ring_mode=ring_mode, delay_values=delay_values,
         connect_tick=connect_tick, bucket_counts=bucket_counts,
         telemetry_on=tel, exchange_mode=exchange_mode,
-        delta_capacity=capacity, async_k=k_async,
+        delta_capacity=capacity, hub_count=hub_n,
+        delta_aggregate=aggregate, async_k=k_async,
     )
     n_share_shards = mesh.shape[SHARES_AXIS]
     exch_counters = np.zeros(3, dtype=np.int64)  # used, ovf, fallback
@@ -1522,6 +1665,7 @@ def run_sharded_sim(
                     ell_args, degree, churn_start, churn_end,
                     origins, gen_ticks, t_start, last_gen, snap_ticks_arr,
                     *((need,) if delta_on else ()),
+                    *((hub_ops[1], hub_ops[2]) if hub_ops else ()),
                 )
             r, s, sn = out[0], out[1], out[2]
             if tel:
@@ -1583,7 +1727,7 @@ def run_sharded_sim(
         _achieved_exchange_report(
             exchange_extra, exch_counters, exch_ticks,
             mesh.shape[NODES_AXIS], n_padded // mesh.shape[NODES_AXIS],
-            bitmask.num_words(chunk_size), capacity,
+            bitmask.num_words(chunk_size), capacity, hub_count=hub_n,
         )
         if delta_on
         else exchange_extra
@@ -1611,6 +1755,8 @@ def run_sharded_flood_coverage(
     bucket_min_rows: int = 2048,
     exchange: str = "dense",
     async_k: int = 2,
+    hub_rows: int | None = None,
+    aux_cache: tuple | None = None,
 ):
     """Flood coverage-time experiment on the device mesh — the BASELINE
     north-star metric (time-to-99% coverage at 1M nodes on a v5e-8 mesh)
@@ -1647,16 +1793,19 @@ def run_sharded_flood_coverage(
         ring_mode, uniform, ring, n_padded, mesh.shape[NODES_AXIS],
         bitmask.num_words(chunk_size), ell_idx, ell_delay, ell_mask,
         block=block, bucket_min_rows=bucket_min_rows, exchange=exchange,
+        hub_rows=hub_rows, aux_cache=aux_cache,
     )
-    exchange_mode, need, capacity, exchange_extra = exchange_plan
-    delta_on = exchange_mode == "delta"
+    (exchange_mode, need, capacity, exchange_extra, hub_ops,
+     aggregate) = exchange_plan
+    delta_on = exchange_mode in ("delta", "hub")
+    hub_n = hub_ops[0] if hub_ops else 0
     if k_async:
         exchange_extra.update(async_ticks.modeled_overlap_report(
             exchange_mode,
             (uniform,) if uniform is not None else delay_values,
             k_async, mesh.shape[NODES_AXIS],
             n_padded // mesh.shape[NODES_AXIS],
-            bitmask.num_words(chunk_size), capacity,
+            bitmask.num_words(chunk_size), capacity, hub_count=hub_n,
         ))
     _rss_log("ring staged")
     tel = telemetry.rings_enabled()
@@ -1666,6 +1815,7 @@ def run_sharded_flood_coverage(
         ring_mode=ring_mode, delay_values=delay_values,
         bucket_counts=bucket_counts, telemetry_on=tel,
         exchange_mode=exchange_mode, delta_capacity=capacity,
+        hub_count=hub_n, delta_aggregate=aggregate,
         async_k=k_async,
     )
     o, g_ticks = sched.padded(pass_size, horizon_ticks)
@@ -1678,6 +1828,7 @@ def run_sharded_flood_coverage(
             o, g_ticks, np.int32(0), np.int32(0),
             np.zeros((0,), dtype=np.int32),
             *((need,) if delta_on else ()),
+            *((hub_ops[1], hub_ops[2]) if hub_ops else ()),
         )
     digest_head = None
     r, snt, cov = out[0], out[1], out[3]
@@ -1738,7 +1889,7 @@ def run_sharded_flood_coverage(
         exchange_extra = _achieved_exchange_report(
             exchange_extra, counters, int(ec[:, 4].sum()),
             mesh.shape[NODES_AXIS], n_padded // mesh.shape[NODES_AXIS],
-            bitmask.num_words(chunk_size), capacity,
+            bitmask.num_words(chunk_size), capacity, hub_count=hub_n,
         )
     stats.extra["exchange"] = exchange_extra
     return stats, coverage

@@ -39,6 +39,27 @@ quarter of the dense per-tick slice traffic, so a no-overflow delta tick
 is guaranteed >= 2x cheaper on the wire (each entry ships 2 words), and
 overflow ticks degrade to exactly the dense cost plus the (bounded)
 delta attempt.
+
+Two node-aware refinements ride on top (PAPERS.md: node-aware SpMV,
+Sparse Allreduce for power-law data):
+
+- **destination-shard aggregation** (`compress_deltas(aggregate=True)`,
+  chosen host-side by `choose_aggregate`): the per-destination buffers
+  pack through ONE destination-major 1-D scatter instead of two 2-D
+  dual-index scatters — bitwise-identical output, half the scatter
+  address words.
+- **degree-split hub/tail transport** (`exchange="hub"`, planned
+  host-side by `plan_hub_split` / `plan_partnered_hub_split`): a static
+  hub set of high-fan-out rows ships every tick as a plain index-free
+  `all_gather` block while the sparse tail stays on (idx, val) delta
+  buffers whose capacity shrinks with the hubs removed. A scale-free
+  hub's words cross each mesh edge once as w dense words instead of 2w
+  indexed words per destination. The split threshold is searched over
+  the same `modeled_exchange_words_per_tick` cost model the observatory
+  prices with; h=0 degenerates to pure delta. Exact by the same
+  OR-monotonicity argument: tail scatter rows and hub overlay rows are
+  disjoint (the tail plan excludes hub rows), and the dense overflow
+  fallback covers hub rows too.
 """
 
 from __future__ import annotations
@@ -102,6 +123,7 @@ def modeled_exchange_words_per_tick(
     w: int,
     delay_splits: int = 1,
     capacity: int = 0,
+    hub_count: int = 0,
 ) -> int:
     """Per-chip per-tick exchange words received over ICI, by path —
     THE traffic model `scripts/cost_report.py` and the engines'
@@ -115,6 +137,9 @@ def modeled_exchange_words_per_tick(
       2 words per entry, capacity entries per peer, delay-count
       independent. Overflow ticks add the dense cost back per fallback
       read (accounted separately by the achieved counters).
+    - ``"hub"``: ``hub_count`` hub rows per shard ride an index-free
+      all_gather (w words per row per peer) and the tail stays on the
+      delta buffers (``capacity`` is the TAIL capacity).
     - ``"none"``: no cross-shard reads (fanout push's sharded ring).
     """
     if n_shards <= 1 or mode == "none":
@@ -125,7 +150,250 @@ def modeled_exchange_words_per_tick(
         return delay_splits * (n_shards - 1) * n_loc * w
     if mode == "delta":
         return (n_shards - 1) * 2 * capacity
+    if mode == "hub":
+        # Index-free hub block (w words per hub row per peer) + the
+        # residual tail's (idx, val) delta buffers.
+        return (n_shards - 1) * (hub_count * w + 2 * capacity)
     raise ValueError(f"unknown exchange mode {mode!r}")
+
+
+def modeled_pack_index_words(
+    n_dests: int, capacity: int, aggregate: bool
+) -> int:
+    """Scatter address words one compress pack spends per tick: the
+    unaggregated pack drives two (dest, slot) dual-index 2-D scatters
+    (2 address words per slot), the destination-major aggregate one
+    flat 1-D scatter per buffer (1 address word per slot), over the
+    same ``n_dests * (capacity + 1)`` slots either way."""
+    return (1 if aggregate else 2) * n_dests * (capacity + 1)
+
+
+def choose_aggregate(n_dests: int, capacity: int) -> bool:
+    """Host-side per-fingerprint default for ``compress_deltas``'s
+    ``aggregate`` flag: True whenever the modeled aggregated pack is
+    strictly cheaper than the unaggregated one. The outputs are
+    bitwise-identical either way (tests/test_exchange.py pins it), so
+    this is purely a cost-model decision — recorded by the drivers in
+    ``stats.extra['exchange']['aggregated']``."""
+    return modeled_pack_index_words(
+        n_dests, capacity, True
+    ) < modeled_pack_index_words(n_dests, capacity, False)
+
+
+def _hub_cost_curve(
+    tail_worst, n_node_shards: int, n_loc: int, w: int, delay_splits: int
+) -> tuple[list[int], list[int], int | None]:
+    """Shared h-search: candidate hub sizes (multiples of 8 in
+    [0, n_loc]), the modeled words/tick at each, and the crossover (the
+    smallest h > 0 strictly beating the pure-delta h = 0 point).
+    ``tail_worst`` maps candidate h -> worst per-(src, dst) tail rows."""
+    cands = list(range(0, n_loc + 1, 8))
+    if cands[-1] != n_loc:
+        cands.append(n_loc)
+    words = [
+        modeled_exchange_words_per_tick(
+            "hub", n_shards=n_node_shards, n_loc=n_loc, w=w,
+            capacity=delta_capacity(
+                tail_worst(h), n_loc, w, delay_splits
+            ),
+            hub_count=h,
+        )
+        for h in cands
+    ]
+    crossover = next(
+        (h for h, wd in zip(cands, words) if h and wd < words[0]), None
+    )
+    return cands, words, crossover
+
+
+def plan_hub_split(
+    need: np.ndarray,          # (n_padded, k) bool — plan_flood_exchange
+    need_counts: np.ndarray,   # (k, k) int64
+    n_node_shards: int,
+    n_loc: int,
+    w: int,
+    delay_splits: int = 1,
+    hub_rows: int | None = None,
+) -> dict:
+    """Static degree-split for the flood engines' ``exchange="hub"``.
+
+    Ranks each shard's rows by destination fan-out (how many remote
+    shards read the row — the wire cost a hub row charges the delta
+    path per tick) and searches hub sizes h (uniform across shards,
+    multiples of 8 for static shapes) for the minimum of the shared
+    cost model ``(k-1) * (h*w + 2*cap_tail(h))``; ties break toward
+    smaller h and h = 0 degenerates to pure delta. ``hub_rows`` pins h
+    (deterministic tests; hub-free graphs where the search picks 0).
+
+    Returns ``{hub_count, hub_local (k, h) int32 local row ids,
+    hub_global (k, h) int32 global row ids, need_tail (the need plan
+    with hub rows cleared — tail buffers never re-ship a hub row),
+    capacity (tail capacity), report (crossover + modeled words for
+    scripts/cost_report.py --exchange)}``."""
+    k = n_node_shards
+    need = np.asarray(need, dtype=bool)
+    need_counts = np.asarray(need_counts, dtype=np.int64)
+    fan = need.sum(axis=1).reshape(k, n_loc)
+    # Stable argsort on -fan: descending fan-out, row-id tiebreak.
+    order = np.argsort(-fan, axis=1, kind="stable")
+    ranked = np.take_along_axis(
+        need.reshape(k, n_loc, k), order[:, :, None], axis=1
+    )
+    # cum[s, h, d]: how many of shard s's top-h rows are in d's cut.
+    cum = np.concatenate(
+        [np.zeros((k, 1, k), dtype=np.int64),
+         np.cumsum(ranked, axis=1, dtype=np.int64)],
+        axis=1,
+    )
+
+    def tail_worst(h: int) -> int:
+        return int((need_counts - cum[:, h, :]).max(initial=0))
+
+    cands, words, crossover = _hub_cost_curve(
+        tail_worst, k, n_loc, w, delay_splits
+    )
+    if hub_rows is not None:
+        h = max(0, min(int(hub_rows), n_loc))
+    else:
+        h = cands[int(np.argmin(words))]
+    hub_local = order[:, :h].astype(np.int32)
+    hub_global = (
+        hub_local + np.arange(k, dtype=np.int32)[:, None] * n_loc
+    )
+    need_tail = need.copy()
+    if h:
+        need_tail[hub_global.reshape(-1), :] = False
+    capacity = delta_capacity(
+        int(
+            need_tail.reshape(k, n_loc, k).sum(axis=1).max(initial=0)
+        ),
+        n_loc, w, delay_splits,
+    )
+    report = {
+        "hub_count": h,
+        "hub_rows_forced": hub_rows is not None,
+        "crossover_h": crossover,
+        "modeled_hub_words_per_tick": modeled_exchange_words_per_tick(
+            "hub", n_shards=k, n_loc=n_loc, w=w, capacity=capacity,
+            hub_count=h,
+        ),
+        # The pure-delta point of the same curve — what h beats.
+        "modeled_delta_words_per_tick": words[0],
+    }
+    return {
+        "hub_count": h, "hub_local": hub_local, "hub_global": hub_global,
+        "need_tail": need_tail, "capacity": capacity, "report": report,
+    }
+
+
+def plan_partnered_hub_split(
+    degree: np.ndarray,        # (>= n_padded,) node degrees (0-padded)
+    n_node_shards: int,
+    n_loc: int,
+    w: int,
+    delay_splits: int = 1,
+    hub_rows: int | None = None,
+) -> dict:
+    """Degree-split for the partnered protocols' ``exchange="hub"``.
+
+    Anti-entropy partner picks are global-random, so every shard needs
+    every row (``need`` is all-ones) and fan-out cannot rank the split;
+    node DEGREE does — hub rows are the ones whose d_words stay hot.
+    The tail's worst case is uniform (``n_loc - h`` rows per shard), so
+    the cost curve only rewards a hub once ``(n_loc - h) * w`` drops
+    under the capacity clamp; the search is honest about that (h = 0
+    wins on most shapes) and ``hub_rows`` pins h for the engines'
+    parity tests. Same return contract as `plan_hub_split` with
+    ``need_tail`` shaped (n_padded, 1) — the partnered compress's
+    single-destination cut mask."""
+    k = n_node_shards
+    n_padded = k * n_loc
+    deg = np.zeros(n_padded, dtype=np.int64)
+    m = min(n_padded, len(degree))
+    deg[:m] = np.asarray(degree[:m], dtype=np.int64)
+    order = np.argsort(-deg.reshape(k, n_loc), axis=1, kind="stable")
+
+    def tail_worst(h: int) -> int:
+        return n_loc - h
+
+    cands, words, crossover = _hub_cost_curve(
+        tail_worst, k, n_loc, w, delay_splits
+    )
+    if hub_rows is not None:
+        h = max(0, min(int(hub_rows), n_loc))
+    else:
+        h = cands[int(np.argmin(words))]
+    hub_local = order[:, :h].astype(np.int32)
+    hub_global = (
+        hub_local + np.arange(k, dtype=np.int32)[:, None] * n_loc
+    )
+    need_tail = np.ones((n_padded, 1), dtype=bool)
+    if h:
+        need_tail[hub_global.reshape(-1), :] = False
+    capacity = delta_capacity(max(1, n_loc - h), n_loc, w, delay_splits)
+    report = {
+        "hub_count": h,
+        "hub_rows_forced": hub_rows is not None,
+        "crossover_h": crossover,
+        "modeled_hub_words_per_tick": modeled_exchange_words_per_tick(
+            "hub", n_shards=k, n_loc=n_loc, w=w, capacity=capacity,
+            hub_count=h,
+        ),
+        "modeled_delta_words_per_tick": words[0],
+    }
+    return {
+        "hub_count": h, "hub_local": hub_local, "hub_global": hub_global,
+        "need_tail": need_tail, "capacity": capacity, "report": report,
+    }
+
+
+def cached_flood_plan(
+    ell_idx: np.ndarray,
+    ell_mask: np.ndarray,
+    n_node_shards: int,
+    aux_cache: tuple | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """`plan_flood_exchange`, optionally persisted through the
+    fingerprinted npz graph aux cache
+    (models/topology.load_or_compute_graph_aux) so the 100K/1M-node cut
+    scans run ONCE per graph build, like the partition labels they
+    derive from. ``aux_cache`` is ``(path, fp, key)``; the key must
+    encode everything that shapes the cut beyond the graph build —
+    shard count, partition relabel seed — the caller owns that policy
+    (scripts/mesh_rehearsal.py)."""
+    def compute() -> np.ndarray:
+        return plan_flood_exchange(ell_idx, ell_mask, n_node_shards)[0]
+
+    if aux_cache:
+        from p2p_gossip_tpu.models.topology import (
+            load_or_compute_graph_aux,
+        )
+        from p2p_gossip_tpu.utils import logging as p2plog
+
+        path, fp, key = aux_cache
+        need = load_or_compute_graph_aux(
+            path, key, fp, lambda: compute().astype(np.uint8),
+            p2plog.get_logger("Parallel.Exchange").info,
+        ).astype(bool)
+    else:
+        need = compute()
+    n_loc = need.shape[0] // n_node_shards
+    need_counts = need.reshape(
+        n_node_shards, n_loc, n_node_shards
+    ).sum(axis=1).astype(np.int64)
+    return need, need_counts
+
+
+def overlay_hub(
+    recon: jnp.ndarray,       # (n_padded, w) scattered tail canvas
+    hub_global: jnp.ndarray,  # (k, h) int32 global hub row ids
+    hub_block: jnp.ndarray,   # (k * h, w) uint32 all_gathered hub rows
+) -> jnp.ndarray:
+    """Overlay the all_gathered hub block onto a scattered tail canvas.
+    A plain ``.set`` is exact: the tail plan excludes hub rows, so the
+    two row sets are disjoint, and the reader's own-slice overlay (when
+    it runs) lands last with identical values for own hub rows."""
+    return recon.at[hub_global.reshape(-1)].set(hub_block)
 
 
 def compress_deltas(
@@ -246,6 +514,26 @@ def _audit_spec(kind: str):
             integer_only=True,
             bitmask_words=(w, cap),
         )
+    if kind == "hub":
+        h = 2
+        recon = jnp.zeros((shards * n_loc, w), dtype=jnp.uint32)
+        hub_global = jnp.asarray(
+            np.stack([
+                rng.choice(n_loc, h, replace=False) + s * n_loc
+                for s in range(shards)
+            ]),
+            dtype=jnp.int32,
+        )
+        hub_block = jnp.asarray(
+            rng.integers(0, 1 << 32, (shards * h, w), dtype=np.uint64),
+            dtype=jnp.uint32,
+        )
+        return AuditSpec(
+            fn=overlay_hub,
+            args=(recon, hub_global, hub_block),
+            integer_only=True,
+            bitmask_words=(w, cap),
+        )
     idx = jnp.asarray(
         rng.integers(-1, n_loc * w, (shards, cap), dtype=np.int64),
         dtype=jnp.int32,
@@ -275,4 +563,8 @@ register_entry(
 register_entry(
     "parallel.exchange.scatter_deltas[delta]",
     spec=lambda: _audit_spec("scatter"),
+)
+register_entry(
+    "parallel.exchange.overlay_hub[hub]",
+    spec=lambda: _audit_spec("hub"),
 )

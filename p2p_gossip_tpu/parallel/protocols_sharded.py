@@ -127,6 +127,8 @@ def build_partnered_runner(
     telemetry_on: bool = False,
     exchange_mode: str = "dense",
     delta_capacity: int = 0,
+    hub_count: int = 0,
+    delta_aggregate: bool = False,
     replica_axis: str | None = None,
     local_replicas: int = 1,
     per_replica_loss: bool = False,
@@ -167,6 +169,20 @@ def build_partnered_runner(
     counters, one extra trailing (1, 8) uint32 counter output
     [used_entries_lo, used_entries_hi, overflow_write_ticks,
     dense_fallback_reads, exchange_ticks, 0, 0, 0] per share-shard.
+
+    ``exchange_mode`` "hub" rides the same machinery with the
+    degree-split transport on top: ``hub_count`` top-degree rows per
+    shard ship their delta words index-free via a per-round all_gather
+    into a slot-aligned hub ring, and the sparse buffers carry only the
+    tail cut — the caller appends three operands (``need_tail``
+    (n_padded, 1) bool, ``hub_local`` (k, h), ``hub_global`` (k, h))
+    after the base eight. The mirror advance overlays the slot's hub
+    block onto the scattered tail canvas (disjoint row sets) before the
+    OR — bitwise-identical by OR-monotonicity. ``hub_count == 0``
+    degenerates to the plain delta program. ``delta_aggregate`` selects
+    compress_deltas's destination-major pack (host-side
+    `exchange.choose_aggregate` decision; outputs are bitwise-identical
+    either way).
 
     ``async_k`` > 0 (sharded ring, anti-entropy only — the driver feeds
     delays already clamped to >= K via `clamp_partner_delays`) enables
@@ -210,7 +226,11 @@ def build_partnered_runner(
     anti = protocol in ("pushpull", "pull")
     sharded_ring = ring_mode == "sharded"
     hist_rows = (n_padded // n_node_shards) if sharded_ring else n_padded
-    delta = exchange_mode == "delta"
+    # "hub" rides the delta machinery: tail rows on the sparse buffers,
+    # hub rows on a dense per-round all_gather block (hub_count == 0
+    # degenerates to the plain delta program — no zero-size collectives).
+    delta = exchange_mode in ("delta", "hub")
+    hub = exchange_mode == "hub" and hub_count > 0
     if delta and not (sharded_ring and anti):
         raise ValueError(
             "exchange_mode='delta' needs the sharded ring and an "
@@ -250,10 +270,14 @@ def build_partnered_runner(
         # checks need every node's intervals — and the seed scalar.
         # Campaign mode prepends a local replica dim rb to churn_*,
         # origins, gen_ticks and the seed, and appends the per-replica
-        # loss-seed vector (rb,) when per_replica_loss.
-        lseeds = (
-            extra_args[0] if (campaign and per_replica_loss) else None
-        )
+        # loss-seed vector (rb,) when per_replica_loss. The hub split
+        # appends (need_tail, hub_local, hub_global) after that.
+        base_extra = 1 if (campaign and per_replica_loss) else 0
+        lseeds = extra_args[0] if base_extra else None
+        if hub:
+            need_tail = extra_args[base_extra]          # (n_loc, 1) bool
+            hub_rows_l = extra_args[base_extra + 1][0]  # (h,) local rows
+            hub_global = extra_args[base_extra + 2]     # (k, h) global
         row_offset = lax.axis_index(NODES_AXIS).astype(jnp.int32) * n_loc
         node_ids = row_offset + jnp.arange(n_loc, dtype=jnp.int32)
         slots = jnp.arange(chunk_size, dtype=jnp.int32)
@@ -283,7 +307,12 @@ def build_partnered_runner(
         if delta:
             # Every shard needs every delta (global-random partners):
             # one buffer per shard, all rows candidates, self included.
-            need_all = jnp.ones((n_loc, 1), dtype=jnp.bool_)
+            # Under the hub split the dense block ships the hub rows,
+            # so the sparse buffers carry only the tail cut.
+            need_all = (
+                need_tail if hub
+                else jnp.ones((n_loc, 1), dtype=jnp.bool_)
+            )
             state = state + (
                 # Per-delay mirrors of the global (t - d) seen slices —
                 # invariant at entry to body(t): mirrors[j] equals the
@@ -306,9 +335,20 @@ def build_partnered_runner(
                 #  exchange_ticks, 0, 0, 0]
                 jnp.zeros((8,), dtype=jnp.uint32),
             )
+        if hub:
+            # Hub delta-word blocks, slot-aligned with didx_ring: every
+            # shard's hub-row d_words, all_gathered each round. OR-ing a
+            # slot's block into a mirror is exact (deltas are
+            # OR-monotone; unwritten slots hold zeros — a no-op).
+            state = state + (
+                jnp.zeros(
+                    (ring_size, n_node_shards * hub_count, w),
+                    dtype=jnp.uint32,
+                ),
+            )
         landed_i = (
             6 + (1 if tel else 0) + (1 if dig else 0)
-            + (5 if delta else 0)
+            + (5 if delta else 0) + (1 if hub else 0)
         )
         if landed_on:
             # Async landed double-buffer: one prefetched global (t - d)
@@ -335,6 +375,7 @@ def build_partnered_runner(
             if delta:
                 (mirrors, didx_ring, dval_ring, dflag_ring,
                  ectr) = rstate[ex_i:ex_i + 5]
+                hub_ring = rstate[ex_i + 5] if hub else None
             landed = rstate[landed_i] if landed_on else None
             # The remote views THIS round folds in (pre-advance) — what
             # the staleness telemetry charges against.
@@ -518,7 +559,8 @@ def build_partnered_runner(
                 # is exactly the words OR-advancing every mirror needs.
                 d_words = exchange & ~prev
                 cidx, cval, dcounts = exch.compress_deltas(
-                    d_words, need_all, delta_capacity
+                    d_words, need_all, delta_capacity,
+                    aggregate=delta_aggregate,
                 )
                 idx_recv = lax.all_gather(cidx, NODES_AXIS, axis=0, tiled=True)
                 val_recv = lax.all_gather(cval, NODES_AXIS, axis=0, tiled=True)
@@ -530,6 +572,13 @@ def build_partnered_runner(
                 didx_ring = didx_ring.at[slot_w].set(idx_recv)
                 dval_ring = dval_ring.at[slot_w].set(val_recv)
                 dflag_ring = dflag_ring.at[slot_w].set(ovf)
+                if hub:
+                    # Index-free hub leg: the hub rows' delta words ride
+                    # a plain all_gather into the slot-aligned hub ring.
+                    hub_all = lax.all_gather(
+                        d_words[hub_rows_l], NODES_AXIS, axis=0, tiled=True
+                    )
+                    hub_ring = hub_ring.at[slot_w].set(hub_all)
                 # Advance each mirror to the slice next round reads:
                 # u = t + 1 - d. A flagged slot dense-resets from a full
                 # slice all_gather (the hist slot IS the cumulative
@@ -547,9 +596,18 @@ def build_partnered_runner(
                         )
 
                     def sparse_m(_, s=slot_u, mj=mirrors[j]):
-                        return mj | exch.scatter_deltas(
+                        recon = exch.scatter_deltas(
                             didx_ring[s], dval_ring[s], n_loc, w, n_padded
                         )
+                        if hub:
+                            # Overlay the slot's hub block onto the tail
+                            # canvas (disjoint rows — the tail plan
+                            # excludes hub rows), then OR the combined
+                            # delta into the mirror.
+                            recon = exch.overlay_hub(
+                                recon, hub_global, hub_ring[s]
+                            )
+                        return mj | recon
 
                     new_mirrors.append(
                         lax.cond(
@@ -602,7 +660,11 @@ def build_partnered_runner(
                 # with the rest of the row.
                 if delta:
                     ex_words = (
-                        jnp.uint32((n_node_shards - 1) * 2 * delta_capacity)
+                        jnp.uint32(
+                            (n_node_shards - 1)
+                            * (2 * delta_capacity
+                               + (hub_count * w if hub else 0))
+                        )
                         + fb_t * jnp.uint32((n_node_shards - 1) * n_loc * w)
                     )
                 elif sharded_ring:
@@ -663,6 +725,8 @@ def build_partnered_runner(
                 out = out + (tel_digest.write(rstate[dig_i], t, dval),)
             if delta:
                 out = out + (mirrors, didx_ring, dval_ring, dflag_ring, ectr)
+            if hub:
+                out = out + (hub_ring,)
             if landed_on:
                 out = out + (landed,)
             return out
@@ -721,7 +785,11 @@ def build_partnered_runner(
             P(replica_axis, None),        # origins (R, chunk)
             P(replica_axis, None),        # gen_ticks
             P(replica_axis),              # seed (R,)
-        ) + ((P(replica_axis),) if per_replica_loss else ())
+        ) + ((P(replica_axis),) if per_replica_loss else ()) + ((
+            P(NODES_AXIS, None),  # need_tail (n_padded, 1)
+            P(NODES_AXIS, None),  # hub_local (k, h)
+            P(None, None),        # hub_global (k, h) replicated
+        ) if hub else ())
         out_specs: tuple = (
             P(replica_axis, NODES_AXIS),
             P(replica_axis, NODES_AXIS),
@@ -744,7 +812,11 @@ def build_partnered_runner(
             P(SHARES_AXIS),       # origins
             P(SHARES_AXIS),       # gen_ticks
             P(),                  # seed
-        )
+        ) + ((
+            P(NODES_AXIS, None),  # need_tail (n_padded, 1)
+            P(NODES_AXIS, None),  # hub_local (k, h)
+            P(None, None),        # hub_global (k, h) replicated
+        ) if hub else ())
         out_specs = (
             P(SHARES_AXIS, NODES_AXIS),
             P(SHARES_AXIS, NODES_AXIS),
@@ -809,6 +881,7 @@ def _audit_spec_partnered_runner(
     n_padded = ell_idx.shape[0]
     churn_start, churn_end = _padded_churn(None, n_padded, n_node_shards)
     capacity = 0
+    hub_args: tuple = ()
     if exchange == "delta":
         from p2p_gossip_tpu.parallel import exchange as exch
 
@@ -822,6 +895,27 @@ def _audit_spec_partnered_runner(
             delta_capacity=capacity,
             replica_axis=("replicas" if campaign else None),
             local_replicas=(local_replicas if campaign else 1),
+        )
+    elif exchange == "hub":
+        # Forced split — the tiny ER graph has no natural hubs, so the
+        # honest planner would pick h = 0 and skip the hub program.
+        from p2p_gossip_tpu.parallel import exchange as exch
+
+        n_loc = n_padded // n_node_shards
+        w = bitmask.num_words(chunk)
+        hplan = exch.plan_partnered_hub_split(
+            degree, n_node_shards, n_loc, w, hub_rows=2
+        )
+        capacity = hplan["capacity"]
+        runner, pass_size = build_partnered_runner(
+            mesh, protocol, n_padded, ring, chunk, horizon, 1,
+            (1 << 20, 7), False, ring_mode="sharded", delay_values=(1,),
+            telemetry_on=telemetry_on, exchange_mode="hub",
+            delta_capacity=capacity, hub_count=hplan["hub_count"],
+            delta_aggregate=True,
+        )
+        hub_args = (
+            hplan["need_tail"], hplan["hub_local"], hplan["hub_global"],
         )
     elif async_k:
         ell_delays = async_ticks.clamp_partner_delays(ell_delays, async_k)
@@ -858,7 +952,7 @@ def _audit_spec_partnered_runner(
         # Stacked per-shard digest rings are (1, horizon) uint32 — the
         # horizon is a declared minor width, like NUM_METRICS.
         words = words + (NUM_METRICS, horizon)
-    if exchange == "delta":
+    if exchange in ("delta", "hub"):
         # Delta buffers (capacity minor dim) and the (1, 8) counter row.
         words = words + (capacity, 8)
     seed = (
@@ -870,7 +964,7 @@ def _audit_spec_partnered_runner(
         args=(
             ell_idx, ell_delays, degree, churn_start, churn_end,
             origins, gen_ticks, seed,
-        ),
+        ) + hub_args,
         integer_only=True,
         bitmask_words=words,
     )
@@ -906,6 +1000,149 @@ register_entry(
     "parallel.protocols_sharded.pushpull_runner[async]",
     spec=lambda: _audit_spec_partnered_runner("pushpull", async_k=2),
 )
+register_entry(
+    "parallel.protocols_sharded.pushpull_runner[hub]",
+    spec=lambda: _audit_spec_partnered_runner("pushpull", exchange="hub"),
+)
+
+
+def _resolve_partnered_exchange(
+    exchange: str,
+    protocol: str,
+    ring_mode: str,
+    ell_delays: np.ndarray,
+    ring: int,
+    n_padded: int,
+    n_node_shards: int,
+    w: int,
+    degree: np.ndarray,
+    k_async: int = 0,
+    stale_values: tuple = (),
+    stale_amounts: tuple = (),
+    hub_rows: int | None = None,
+) -> tuple:
+    """Shared exchange/ring resolution for the partnered drivers (solo
+    and campaign — batch/campaign_sharded.py calls this too): pick the
+    ring layout, resolve "auto", plan the delta capacity — and under
+    ``exchange="hub"`` the degree split
+    (`exchange.plan_partnered_hub_split`; partner picks are
+    global-random, so node degree ranks the hub set, and the honest
+    cost model usually picks h = 0 unless ``hub_rows`` pins it) — and
+    assemble the ``stats.extra['exchange']`` report skeleton.
+
+    Returns ``(ring_mode, ring_bytes, delay_values, exchange, capacity,
+    hub_ops, aggregate, delta_on, exchange_extra, async_staleness)``
+    where ``hub_ops`` is None or ``(hub_count, need_tail, hub_local,
+    hub_global)`` — the builder static plus the three input operands the
+    runner dispatch appends after the base eight."""
+    from p2p_gossip_tpu.parallel import exchange as exch_mod
+    from p2p_gossip_tpu.parallel.engine_sharded import resolve_ring_mode
+
+    if exchange not in ("dense", "delta", "auto", "hub"):
+        raise ValueError(f"unknown exchange mode {exchange!r}")
+    anti = protocol in ("pushpull", "pull")
+    if exchange in ("delta", "hub") and anti:
+        # The sparse paths compress the sharded ring's read exchange.
+        ring_mode = "sharded"
+    distinct = tuple(int(v) for v in np.unique(ell_delays))
+    if ring_mode == "auto" and protocol == "pushk":
+        # Fanout push reads only its own rows' history: the sharded ring
+        # drops the exchange all_gather outright.
+        ring_mode = "sharded"
+    ring_mode, ring_bytes = resolve_ring_mode(
+        ring_mode, distinct[0] if len(distinct) == 1 else None,
+        ring, n_padded, n_node_shards, w,
+    )
+    delay_values = distinct if ring_mode == "sharded" and anti else None
+    if exchange == "auto":
+        exchange = (
+            "delta"
+            if anti and ring_mode == "sharded" and n_node_shards > 1
+            else "dense"
+        )
+    delta_on = (
+        exchange in ("delta", "hub") and anti and ring_mode == "sharded"
+    )
+    n_loc = n_padded // n_node_shards
+    hub_ops = None
+    hub_report = None
+    if exchange == "hub" and delta_on:
+        hplan = exch_mod.plan_partnered_hub_split(
+            degree, n_node_shards, n_loc, w,
+            delay_splits=len(delay_values), hub_rows=hub_rows,
+        )
+        capacity = hplan["capacity"]
+        hub_report = hplan["report"]
+        if hplan["hub_count"] > 0:
+            hub_ops = (
+                hplan["hub_count"], hplan["need_tail"],
+                hplan["hub_local"], hplan["hub_global"],
+            )
+        # hub_count == 0 degenerates to plain delta on the full cut.
+    elif delta_on:
+        # Worst case every local row changes — the anti-entropy delta
+        # has no static cut to restrict it (global-random partners).
+        capacity = exch_mod.delta_capacity(
+            n_loc, n_loc, w, len(delay_values)
+        )
+    else:
+        capacity = 0
+    # Host-side default for compress_deltas(aggregate=...): modeled
+    # scatter-address words (single destination bin here — the delta
+    # rides an all_gather, not an all_to_all).
+    aggregate = exch_mod.choose_aggregate(1, capacity) if delta_on else False
+    dense_kind = (
+        ("dense" if anti else "none")
+        if ring_mode == "sharded" else "replicated"
+    )
+    exchange_extra = {
+        "mode": ("hub" if hub_ops else "delta") if delta_on else dense_kind,
+        "capacity": capacity,
+        "modeled_dense_words_per_tick": (
+            exch_mod.modeled_exchange_words_per_tick(
+                dense_kind, n_shards=n_node_shards, n_loc=n_loc, w=w,
+                delay_splits=len(delay_values) if delay_values else 1,
+            )
+        ),
+    }
+    if delta_on:
+        exchange_extra["aggregated"] = aggregate
+        exchange_extra["modeled_delta_words_per_tick"] = (
+            exch_mod.modeled_exchange_words_per_tick(
+                "delta", n_shards=n_node_shards, n_loc=n_loc, w=w,
+                capacity=capacity,
+            )
+        )
+    if hub_report is not None:
+        exchange_extra.update({
+            "hub_count": hub_report["hub_count"],
+            "hub_rows_forced": hub_report["hub_rows_forced"],
+            "crossover_h": hub_report["crossover_h"],
+            "modeled_hub_words_per_tick": (
+                hub_report["modeled_hub_words_per_tick"]
+            ),
+            "modeled_delta_words_per_tick": (
+                hub_report["modeled_delta_words_per_tick"]
+            ),
+        })
+    if k_async:
+        exchange_extra.update(async_ticks.modeled_overlap_report(
+            ("hub" if hub_ops else "delta") if delta_on else "dense",
+            delay_values, k_async, n_node_shards, n_loc, w, capacity,
+            hub_count=hub_ops[0] if hub_ops else 0,
+        ))
+        # group_offsets sees only clamped delays (amounts all 0 there);
+        # the real added-lateness bookkeeping is pre-clamp.
+        exchange_extra["staleness_amounts"] = list(stale_amounts)
+    amounts_by_value = dict(zip(stale_values, stale_amounts))
+    async_staleness = (
+        tuple(amounts_by_value.get(v, 0) for v in delay_values)
+        if k_async else ()
+    )
+    return (
+        ring_mode, ring_bytes, delay_values, exchange, capacity,
+        hub_ops, aggregate, delta_on, exchange_extra, async_staleness,
+    )
 
 
 def run_sharded_partnered_sim(
@@ -928,6 +1165,7 @@ def run_sharded_partnered_sim(
     ring_mode: str = "auto",
     exchange: str = "dense",
     async_k: int = 2,
+    hub_rows: int | None = None,
 ):
     """Drop-in counterpart of run_pushpull_sim / run_pushk_sim on a device
     mesh: identical per-node counters for any mesh shape (the counter-based
@@ -947,11 +1185,17 @@ def run_sharded_partnered_sim(
     ``exchange`` selects the anti-entropy cross-shard state exchange:
     "dense" (per-delay slice all_gathers, the default), "delta" (sparse
     seen-delta buffers + mirrors, module docstring — forces the sharded
-    ring, bitwise-identical counters), or "auto" (delta whenever the
-    anti-entropy ring is sharded across >1 node shards). Fanout push
-    reads no remote state on the sharded ring, so "delta" degrades to
-    that free path. Resolved mode, modeled traffic, and achieved
-    counters land in ``stats.extra['exchange']``.
+    ring, bitwise-identical counters), "auto" (delta whenever the
+    anti-entropy ring is sharded across >1 node shards), or "hub" (the
+    degree-split transport: the ``hub_rows``-or-planned top-degree rows
+    per shard ship their deltas index-free via a per-round all_gather
+    block while the sparse buffers carry only the tail —
+    `exchange.plan_partnered_hub_split`; the honest cost model usually
+    picks h = 0 here, so ``hub_rows`` pins the split for parity tests).
+    Fanout push reads no remote state on the sharded ring, so "delta"
+    and "hub" degrade to that free path. Resolved mode, modeled
+    traffic, and achieved counters land in
+    ``stats.extra['exchange']``.
 
     "async" / "async-dense" / "async-delta" switch the anti-entropy
     read side to the bounded-staleness async path with ``async_k`` = K
@@ -1010,80 +1254,16 @@ def run_sharded_partnered_sim(
     # the padded ELL delay array — a superset of the valid entries (row
     # padding fills with 1), which costs at most one spare slice
     # all_gather per round and can never miss a real delay.
-    from p2p_gossip_tpu.parallel.engine_sharded import resolve_ring_mode
-
-    if exchange not in ("dense", "delta", "auto"):
-        raise ValueError(f"unknown exchange mode {exchange!r}")
-    anti = protocol in ("pushpull", "pull")
-    if exchange == "delta" and anti:
-        # The delta path compresses the sharded ring's read exchange.
-        ring_mode = "sharded"
-    distinct = tuple(int(v) for v in np.unique(ell_delays))
-    if ring_mode == "auto" and protocol == "pushk":
-        # Fanout push reads only its own rows' history: the sharded ring
-        # drops the exchange all_gather outright.
-        ring_mode = "sharded"
-    ring_mode, ring_bytes = resolve_ring_mode(
-        ring_mode, distinct[0] if len(distinct) == 1 else None,
-        ring, n_padded, n_node_shards, bitmask.num_words(chunk_size),
-    )
-    delay_values = (
-        distinct
-        if ring_mode == "sharded" and protocol in ("pushpull", "pull")
-        else None
-    )
-
-    from p2p_gossip_tpu.parallel import exchange as exch_mod
-
-    if exchange == "auto":
-        exchange = (
-            "delta"
-            if anti and ring_mode == "sharded" and n_node_shards > 1
-            else "dense"
-        )
-    delta_on = exchange == "delta" and anti and ring_mode == "sharded"
     w = bitmask.num_words(chunk_size)
-    n_loc = n_padded // n_node_shards
-    # Worst case every local row changes — the anti-entropy delta has no
-    # static cut to restrict it (partners are global-random).
-    capacity = (
-        exch_mod.delta_capacity(n_loc, n_loc, w, len(delay_values))
-        if delta_on else 0
-    )
-    dense_kind = (
-        ("dense" if anti else "none")
-        if ring_mode == "sharded" else "replicated"
-    )
-    exchange_extra = {
-        "mode": "delta" if delta_on else dense_kind,
-        "capacity": capacity,
-        "modeled_dense_words_per_tick": (
-            exch_mod.modeled_exchange_words_per_tick(
-                dense_kind, n_shards=n_node_shards, n_loc=n_loc, w=w,
-                delay_splits=len(delay_values) if delay_values else 1,
-            )
-        ),
-    }
-    if delta_on:
-        exchange_extra["modeled_delta_words_per_tick"] = (
-            exch_mod.modeled_exchange_words_per_tick(
-                "delta", n_shards=n_node_shards, n_loc=n_loc, w=w,
-                capacity=capacity,
-            )
+    (ring_mode, ring_bytes, delay_values, exchange, capacity, hub_ops,
+     aggregate, delta_on, exchange_extra, async_staleness) = (
+        _resolve_partnered_exchange(
+            exchange, protocol, ring_mode, ell_delays, ring, n_padded,
+            n_node_shards, w, degree, k_async, stale_values,
+            stale_amounts, hub_rows,
         )
-    if k_async:
-        exchange_extra.update(async_ticks.modeled_overlap_report(
-            "delta" if delta_on else "dense",
-            delay_values, k_async, n_node_shards, n_loc, w, capacity,
-        ))
-        # group_offsets sees only clamped delays (amounts all 0 there);
-        # the real added-lateness bookkeeping is pre-clamp.
-        exchange_extra["staleness_amounts"] = list(stale_amounts)
-    amounts_by_value = dict(zip(stale_values, stale_amounts))
-    async_staleness = (
-        tuple(amounts_by_value.get(v, 0) for v in delay_values)
-        if k_async else ()
     )
+    n_loc = n_padded // n_node_shards
 
     tel = telemetry.rings_enabled()
     runner, pass_size = build_partnered_runner(
@@ -1092,8 +1272,10 @@ def run_sharded_partnered_sim(
         loss.static_cfg if loss is not None else None,
         record_coverage,
         ring_mode=ring_mode, delay_values=delay_values, telemetry_on=tel,
-        exchange_mode="delta" if delta_on else "dense",
+        exchange_mode=exchange if delta_on else "dense",
         delta_capacity=capacity,
+        hub_count=hub_ops[0] if hub_ops else 0,
+        delta_aggregate=aggregate,
         async_k=k_async, async_staleness=async_staleness,
     )
     seed_arr = np.uint32(seed & 0xFFFFFFFF)
@@ -1135,10 +1317,13 @@ def run_sharded_partnered_sim(
             "dispatch",
             kernel=f"parallel.protocols_sharded.{protocol}_runner", chunk=ci,
         ):
-            out = runner(
+            args = (
                 ell_idx, ell_delays, degree, churn_start, churn_end,
                 origins, gen_ticks, seed_arr,
             )
+            if hub_ops:
+                args = args + (hub_ops[1], hub_ops[2], hub_ops[3])
+            out = runner(*args)
         digest_head = None
         if delta_on:
             ec = np.asarray(out[-1], dtype=np.uint64)  # (shards, 8)
@@ -1210,6 +1395,7 @@ def run_sharded_partnered_sim(
         exchange_extra = _achieved_exchange_report(
             exchange_extra, exch_counters, exch_ticks,
             n_node_shards, n_loc, w, capacity,
+            hub_count=hub_ops[0] if hub_ops else 0,
         )
     stats.extra["exchange"] = exchange_extra
     if record_coverage:

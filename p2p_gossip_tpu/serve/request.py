@@ -122,6 +122,11 @@ class SimRequest:
     churn_prob: float = 0.0
     mean_down_ticks: float = 10.0
     max_outages: int = 1
+    #: Cross-shard transport on a mesh-backed server: "dense", "delta",
+    #: or "hub" pin the sharded campaign runners' exchange mode; "auto"
+    #: defers to the server's configured default. Single-device servers
+    #: ignore it (the solo campaign runners have no exchange).
+    exchange: str = "auto"
 
     @property
     def replicas(self) -> int:
@@ -157,6 +162,11 @@ class SimRequest:
             self.fanout if self.protocol == "pushk" else None,
             int(self.shares),
             int(self.horizon),
+            # The exchange mode is a static argument of the SHARDED
+            # campaign runners (a different compiled program per mode).
+            # Single-device servers ignore it, where this only costs
+            # batching opportunity — the same tradeoff churn makes.
+            self.exchange,
             # The loss threshold is a static kernel arg; churn values
             # pin the host-side interval sampling (module docstring).
             int(round(float(self.loss_prob) * (1 << 32))),
@@ -231,4 +241,9 @@ def validate_request(d) -> list[str]:
     if not isinstance(d.get("max_outages", 1), int) or \
             d.get("max_outages", 1) < 1:
         errs.append("max_outages must be an int >= 1")
+    if d.get("exchange", "auto") not in ("auto", "dense", "delta", "hub"):
+        errs.append(
+            f"exchange is {d.get('exchange')!r}, expected one of "
+            f"('auto', 'dense', 'delta', 'hub')"
+        )
     return errs

@@ -363,16 +363,23 @@ class GossipServer:
                 run_sharded_protocol_campaign,
             )
 
+            # Per-request transport override — "auto" (the request
+            # default) defers to the server-level configuration. The
+            # mode rides static_signature(), so same-batch units always
+            # agree and each mode compiles once per signature.
+            exchange = (
+                ref.exchange if ref.exchange != "auto" else self.exchange
+            )
             if ref.protocol == "flood":
                 return run_sharded_campaign(
                     graph, replicas, ref.horizon, self.mesh,
-                    record_coverage=True, exchange=self.exchange,
+                    record_coverage=True, exchange=exchange,
                     async_k=self.async_k, **common,
                 )
             return run_sharded_protocol_campaign(
                 graph, replicas, ref.horizon, self.mesh,
                 protocol=ref.protocol, fanout=ref.fanout,
-                record_coverage=True, exchange=self.exchange,
+                record_coverage=True, exchange=exchange,
                 async_k=self.async_k, **common,
             )
         if ref.protocol == "flood":

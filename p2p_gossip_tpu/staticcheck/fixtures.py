@@ -47,7 +47,7 @@ from __future__ import annotations
 
 FIXTURES = (
     "f64", "recompile", "prng", "telemetry", "digest", "exchange",
-    "meshfact", "async",
+    "meshfact", "async", "hub",
 )
 
 
@@ -244,6 +244,50 @@ def exchange_fixture() -> dict:
     }
 
 
+def hub_fixture() -> dict:
+    """Audit a deliberately-bad hub overlay: the flat row ids the
+    all_gathered hub block scatters back onto the reconstruction canvas
+    (shard offset + local hub row) computed through float32 — exact
+    only below 2^24 rows, beyond which two distinct hub rows round to
+    one flat id and the overlay drops a hub's words, bitwise-silently.
+    The integer-only audit (J2, same discipline as the real
+    ``parallel.exchange.overlay_hub[hub]`` entry) must flag the inexact
+    avals."""
+    import jax.numpy as jnp
+
+    from p2p_gossip_tpu.staticcheck.jaxpr_audit import audit_entry
+    from p2p_gossip_tpu.staticcheck.registry import AuditEntry, AuditSpec
+
+    def bad_overlay_hub(recon, hub_local, hub_block):
+        # The seeded bug: per-shard row offsets via float32 arithmetic.
+        k, h = hub_local.shape
+        n_loc = recon.shape[0] // k
+        offs = jnp.arange(k, dtype=jnp.float32) * jnp.float32(n_loc)
+        flat = (hub_local.astype(jnp.float32) + offs[:, None])
+        return recon.at[flat.astype(jnp.int32).reshape(-1)].set(hub_block)
+
+    def spec():
+        return AuditSpec(
+            args=(
+                jnp.zeros((16, 2), dtype=jnp.uint32),
+                jnp.zeros((4, 2), dtype=jnp.int32),
+                jnp.zeros((8, 2), dtype=jnp.uint32),
+            ),
+            integer_only=True,
+        )
+
+    entry = AuditEntry(
+        name="fixtures.hub_bad_overlay",
+        fn=bad_overlay_hub, spec=spec,
+    )
+    violations = audit_entry(entry)
+    return {
+        "fixture": "hub",
+        "ok": not violations,  # must come back False
+        "violations": [v.as_dict() for v in violations],
+    }
+
+
 def async_fixture() -> dict:
     """Audit a deliberately-bad async staleness accounting step: the
     per-tick ``staleness`` column (added-lateness word-folds charged
@@ -340,4 +384,6 @@ def run_fixture(name: str) -> dict:
         return meshfact_fixture()
     if name == "async":
         return async_fixture()
+    if name == "hub":
+        return hub_fixture()
     raise ValueError(f"unknown fixture {name!r}; valid: {FIXTURES}")

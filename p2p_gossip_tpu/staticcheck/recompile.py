@@ -221,6 +221,15 @@ def _serve_compile_sig(server, req) -> tuple:
     loss_on = req.loss_prob > 0.0
     churn_on = req.churn_prob > 0.0
     b = server.slots
+    # The exchange mode is a static arg of the SHARDED campaign runners
+    # only — single-device dispatches ignore it, so folding it into
+    # their signatures would over-count expected compiles.
+    exchange = (
+        (getattr(req, "exchange", "auto")
+         if getattr(req, "exchange", "auto") != "auto"
+         else server.exchange)
+        if server.mesh is not None else None
+    )
     if req.protocol == "flood":
         floor = MIN_CHUNK_SHARES if on_tpu else min(MIN_CHUNK_SHARES, 128)
         chunk = bitmask.num_words(max(s, floor)) * bitmask.WORD_BITS
@@ -228,13 +237,14 @@ def _serve_compile_sig(server, req) -> tuple:
         return (
             "coverage_batch", dg_sig, b, chunk, int(req.horizon), block,
             (thr, None) if loss_on else None, loss_on, churn_on, s,
+            exchange,
         )
     if on_tpu:
         chunk_size = MIN_CHUNK_SHARES
     else:
         chunk_size = min(max(s, 1), min(MIN_CHUNK_SHARES, 128))
     chunk = bitmask.num_words(max(chunk_size, 1)) * bitmask.WORD_BITS
-    common = (dg_sig, b, chunk, int(req.horizon), thr, churn_on)
+    common = (dg_sig, b, chunk, int(req.horizon), thr, churn_on, exchange)
     if req.protocol == "pushk":
         return ("pushk_replicas",) + common + (int(req.fanout),)
     return ("pushpull_replicas",) + common + (req.protocol,)

@@ -461,6 +461,57 @@ def main() -> int:
                       "state-slice exchange on the same run)")
             print()
 
+    hub = by_stage.get("exchange_hub")
+    if hub and hub["results"]:
+        legs = [r for r in hub["results"] if "exchange_mode" in r]
+        if legs:
+            print("## Degree-split hub/tail transport (host-mesh "
+                  "rehearsal, legs bitwise-checked)\n")
+            print(md_table([
+                {
+                    "leg": (
+                        f"{r.get('ring_mode')}/{r.get('exchange_mode')}"
+                        + (f"/K{r['async_k']}" if r.get("async_k") else "")
+                    ),
+                    "nodes": r.get("nodes"),
+                    "topology": r.get("topology"),
+                    "hub_count": (
+                        (r.get("exchange") or {}).get("hub_count")
+                    ),
+                    "modeled_hub_words_per_tick": (
+                        (r.get("exchange") or {})
+                        .get("modeled_hub_words_per_tick")
+                    ),
+                    "achieved_words_per_tick": (
+                        (r.get("exchange") or {})
+                        .get("achieved_delta_words_per_tick")
+                    ),
+                    "wall_s": r.get("wall_s"),
+                }
+                for r in legs
+            ], ["leg", "nodes", "topology", "hub_count",
+                "modeled_hub_words_per_tick", "achieved_words_per_tick",
+                "wall_s"]))
+            hleg = next(
+                (r for r in legs
+                 if (r.get("exchange") or {}).get("mode") == "hub"
+                 and not r.get("async_k")), None)
+            h_ex = (hleg or {}).get("exchange") or {}
+            if h_ex.get("achieved_delta_words_per_tick"):
+                ratio = (
+                    h_ex.get("modeled_dense_words_per_tick", 0)
+                    / h_ex["achieved_delta_words_per_tick"]
+                )
+                print(f"\ndense/hub wire ratio: {ratio:.2f}x "
+                      f"(hub_count {h_ex.get('hub_count')}, crossover_h "
+                      f"{h_ex.get('crossover_h')}; achieved hub+tail "
+                      "words/tick vs the dense state-slice exchange on "
+                      "the same run)")
+            if hub.get("pending_tpu"):
+                print("\n(host-mesh CPU record — pending_tpu: re-captured "
+                      "on the first window with a real multi-chip mesh)")
+            print()
+
     csh = by_stage.get("campaign_sharded")
     if csh and csh["results"]:
         legs = [r for r in csh["results"] if "replica_shards" in r]

@@ -77,6 +77,27 @@ if ! JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   exit 1
 fi
 
+# Hub smoke: the degree-split hub/tail transport (exchange="hub", a
+# forced 8-row hub set — the tiny ER graph has no natural hubs) must
+# stay digest-identical to the dense exchange, and the bisector must
+# still name an injected fault on that pair.
+if ! JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python scripts/divergence.py --pair sync-hub \
+    --n 64 --shares 3 --horizon 16 --json > /tmp/_t1_hub.json; then
+  echo "ci_tier1: FAIL — hub digest smoke (see /tmp/_t1_hub.json;" \
+       "run 'python scripts/divergence.py --pair sync-hub' to" \
+       "reproduce)" >&2
+  exit 1
+fi
+if ! JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python scripts/divergence.py --pair sync-hub \
+    --n 64 --shares 3 --horizon 16 --inject-fault 4 --json \
+    > /tmp/_t1_hub_fault.json; then
+  echo "ci_tier1: FAIL — hub fault-injection self-test (see" \
+       "/tmp/_t1_hub_fault.json)" >&2
+  exit 1
+fi
+
 # Server smoke: a mixed request trace (12 requests, 2 topologies x 3
 # protocols x mixed replica counts) drained in-process through the
 # continuous-batching server on an 8-virtual-device slot mesh, each

@@ -197,13 +197,17 @@ def run_exchange_report(
     """Modeled-vs-achieved exchange words per tick, per topology family.
 
     Runs the sharded flood runner once per family with the sparse
-    frontier-delta exchange and folds the runner's achieved-traffic
-    counters (``stats.extra['exchange']``) against the shared model
-    (`parallel.exchange.modeled_exchange_words_per_tick` — the same
-    formula bench.py and the engines price with). ``winner`` names the
-    cheaper path per family at this scale; the crossover is visible as
-    ``dense_over_delta`` (achieved dense words / achieved delta words —
-    > 1 means the delta path pays for itself)."""
+    frontier-delta exchange, once with the degree-split hub/tail
+    transport (``exchange="hub"``, an 8-row hub set forced so the split
+    is exercised at this tiny scale — real graphs let the modeled
+    crossover in ``hub.crossover_h`` choose), and folds the runner's
+    achieved-traffic counters (``stats.extra['exchange']``) against the
+    shared model (`parallel.exchange.modeled_exchange_words_per_tick` —
+    the same formula bench.py and the engines price with). ``winner``
+    names the cheapest path per family at this scale; the crossovers
+    are visible as ``dense_over_delta`` and ``delta_over_hub``
+    (achieved-word ratios — > 1 means the sparser path pays for
+    itself)."""
     import jax
     import numpy as np
 
@@ -233,11 +237,23 @@ def run_exchange_report(
             dense = ex.get("modeled_dense_words_per_tick", 0)
             achieved = ex.get("achieved_delta_words_per_tick", 0.0)
             row.update(ex)
-            row["winner"] = (
-                "delta" if achieved and achieved < dense else "dense"
+            hub_stats = run_sharded_sim(
+                graph, sched, horizon, mesh, chunk_size=32,
+                exchange="hub", hub_rows=8,
+            )
+            hub_ex = dict(hub_stats.extra.get("exchange", {}))
+            hub_achieved = hub_ex.get("achieved_delta_words_per_tick", 0.0)
+            row["hub"] = hub_ex
+            costs = {"dense": dense or None, "delta": achieved or None,
+                     "hub": hub_achieved or None}
+            row["winner"] = min(
+                (k for k, v in costs.items() if v),
+                key=lambda k: costs[k], default="dense",
             )
             row["dense_over_delta"] = round(
                 dense / achieved, 3) if achieved else None
+            row["delta_over_hub"] = round(
+                achieved / hub_achieved, 3) if hub_achieved else None
             row["ok"] = True
         except Exception as e:  # noqa: BLE001 - ledger must not die
             row["ok"] = False
@@ -254,6 +270,7 @@ def run_exchange_report(
         log(f"exchange: {family}: "
             + (f"dense={row.get('modeled_dense_words_per_tick')} "
                f"delta~{row.get('achieved_delta_words_per_tick', 0):.1f} "
+               f"hub~{(row.get('hub') or {}).get('achieved_delta_words_per_tick', 0):.1f} "
                f"winner={row.get('winner')}"
                if row.get("ok") else f"ERROR {row.get('error')}"))
     return {

@@ -41,6 +41,12 @@ Pairs:
                    double-buffered frontier must be bit-identical to
                    the clamped-delay sync run, tick for tick (skipped
                    when fewer than 4 devices)
+  sync-hub         sharded flood runner with the dense exchange vs the
+                   degree-split hub/tail transport (``exchange="hub"``,
+                   ``hub_rows=8`` forced — the tiny ER workload has no
+                   natural hub set) — the allreduced hub block plus the
+                   sparse tail must OR back to the dense frontier
+                   bit-identically (skipped when fewer than 4 devices)
 
 ``--inject-fault T`` is the bisector's self-test: after collecting each
 pair it flips one bit of the second stream's digest at tick T and
@@ -72,6 +78,7 @@ PAIRS = (
     "sync-delta",
     "sharded-campaign",
     "sync-async",
+    "sync-hub",
 )
 
 
@@ -366,6 +373,43 @@ def pair_sync_async(args):
     return sync, async_
 
 
+def pair_sync_hub(args):
+    import jax
+
+    if len(jax.devices()) < 4:
+        return None
+    from p2p_gossip_tpu.parallel.engine_sharded import run_sharded_sim
+    from p2p_gossip_tpu.parallel.mesh import make_mesh
+    from p2p_gossip_tpu.telemetry import compare
+
+    graph, sched = _workload(args)
+    mesh = make_mesh(2, 2)
+    dense_events = _capture_events(
+        lambda: run_sharded_sim(
+            graph, sched, args.horizon, mesh, chunk_size=args.chunk,
+            ring_mode="sharded",
+        )
+    )
+    # hub_rows=8 forces a non-empty hub set: the tiny ER workload is
+    # too flat for the modeled crossover to pick h > 0 on its own, and
+    # an empty hub would degenerate to the delta pair.
+    hub_events = _capture_events(
+        lambda: run_sharded_sim(
+            graph, sched, args.horizon, mesh, chunk_size=args.chunk,
+            exchange="hub", hub_rows=8,
+        )
+    )
+    dense = compare.select_stream(
+        compare.digest_streams(dense_events), kernel="engine_sharded",
+        shard=0,
+    )
+    hub = compare.select_stream(
+        compare.digest_streams(hub_events), kernel="engine_sharded",
+        shard=0,
+    )
+    return dense, hub
+
+
 _PAIR_FNS = {
     "native-sync": pair_native_sync,
     "sync-campaign": pair_sync_campaign,
@@ -374,6 +418,7 @@ _PAIR_FNS = {
     "sync-delta": pair_sync_delta,
     "sharded-campaign": pair_sharded_campaign,
     "sync-async": pair_sync_async,
+    "sync-hub": pair_sync_hub,
 }
 
 

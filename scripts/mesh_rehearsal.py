@@ -22,8 +22,9 @@ Usage: python scripts/mesh_rehearsal.py [--nodes 100000] [--prob 0.001]
        [--out FILE]    # also append every JSON row to FILE (artifact)
        [--protocol flood|pushpull|pull|pushk]   # partnered legs rehearse
        BASELINE config 5's anti-entropy on the same mesh/ring machinery
-       [--exchange dense|delta|ab]  # sharded-ring wire format; "ab" runs
-       both and reports achieved exchange words/tick side by side
+       [--exchange dense|delta|hub|ab]  # sharded-ring wire format; "ab"
+       runs all three and reports achieved exchange words/tick side by
+       side ([--hub-rows H] forces the hub-set size on flat graphs)
        [--partition]  # relabel nodes by the cached BFS-grown partition
        so each shard owns one partition (minimal cross-shard edge cut)
        [--async-k "1,2,4"]  # bounded-staleness async legs (flood only):
@@ -49,7 +50,9 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _campaign_rehearsal(args, graph, delays, devices, emit) -> int:
+def _campaign_rehearsal(
+    args, graph, delays, devices, emit, aux_base=None
+) -> int:
     """--replicas leg: one factorized-mesh campaign vs the sequential
     solo-sharded loop it replaces. Certifies, per replica and per
     exchange wire format, that the campaign counters are BITWISE the
@@ -99,14 +102,24 @@ def _campaign_rehearsal(args, graph, delays, devices, emit) -> int:
         sched_kw = {"protocol": args.protocol, "fanout": args.fanout}
 
     exchanges = (
-        ("dense", "delta") if args.exchange == "ab" else (args.exchange,)
+        ("dense", "delta", "hub") if args.exchange == "ab"
+        else (args.exchange,)
     )
+    # Campaign and solo meshes shard nodes the same way, so one cached
+    # cut plan (keyed by the node-shard count) serves both drivers.
+    aux_cache = (
+        (aux_base[0], aux_base[1],
+         f"floodcut{n_node_shards}_{aux_base[2]}")
+        if aux_base else None
+    )
+    hub_rows = args.hub_rows or None
     for exchange in exchanges:
         if args.protocol == "flood":
             def run_campaign():
                 return run_sharded_campaign(
                     graph, reps, args.horizon, mesh_c, ell_delays=delays,
                     block=args.block, exchange=exchange,
+                    hub_rows=hub_rows, aux_cache=aux_cache,
                 )
 
             def run_solo(r):
@@ -114,13 +127,14 @@ def _campaign_rehearsal(args, graph, delays, devices, emit) -> int:
                     graph, reps.replica_schedule(r, args.horizon),
                     args.horizon, mesh_s, ell_delays=delays,
                     block=args.block, exchange=exchange,
+                    hub_rows=hub_rows, aux_cache=aux_cache,
                     chunk_size=reps.shares_per_replica,
                 )
         else:
             def run_campaign():
                 return run_sharded_protocol_campaign(
                     graph, reps, args.horizon, mesh_c, ell_delays=delays,
-                    exchange=exchange, **sched_kw,
+                    exchange=exchange, hub_rows=hub_rows, **sched_kw,
                 )
 
             def run_solo(r):
@@ -128,7 +142,7 @@ def _campaign_rehearsal(args, graph, delays, devices, emit) -> int:
                     graph, reps.replica_schedule(r, args.horizon),
                     args.horizon, mesh_s, ell_delays=delays,
                     seed=int(reps.seeds[r]) & 0xFFFFFFFF,
-                    exchange=exchange,
+                    exchange=exchange, hub_rows=hub_rows,
                     chunk_size=reps.shares_per_replica, **sched_kw,
                 )
 
@@ -245,12 +259,20 @@ def main() -> int:
         "full-width ELL) OOMs with it and needs e.g. --chunkSize 64",
     )
     ap.add_argument(
-        "--exchange", choices=("dense", "delta", "ab"), default="dense",
+        "--exchange", choices=("dense", "delta", "hub", "ab"),
+        default="dense",
         help="frontier-exchange wire format for the sharded-ring leg: "
         "dense state-slice all_gathers (default), sparse frontier-delta "
-        "buffers (delta), or ab = run BOTH sharded legs and report the "
-        "achieved exchange words/tick side by side (the dense/delta "
+        "buffers (delta), the degree-split hub/tail transport (hub), or "
+        "ab = run ALL sharded legs (dense, delta, hub) and report the "
+        "achieved exchange words/tick side by side (the wire-format "
         "crossover measurement at rehearsal scale)",
+    )
+    ap.add_argument(
+        "--hub-rows", type=int, default=0,
+        help="force the hub-set size for exchange=hub legs (0 = let the "
+        "modeled word-count crossover choose; a forced value is for "
+        "small graphs whose flat degree profile yields no natural hubs)",
     )
     ap.add_argument(
         "--async-k", type=str, default="",
@@ -277,7 +299,7 @@ def main() -> int:
         "(batch/campaign_sharded.py) — each replica checked bitwise vs "
         "its solo sharded run, with warm/fresh timings vs the "
         "sequential solo-sharded loop; works with --protocol and "
-        "--exchange (ab runs dense and delta legs)",
+        "--exchange (ab runs dense, delta, and hub legs)",
     )
     ap.add_argument(
         "--replica-shards", type=int, default=2,
@@ -381,6 +403,7 @@ def main() -> int:
     )
 
     edge_cut_pct = None
+    aux_base = None
     if args.partition:
         # Partition-centric layout: relabel so each mesh shard owns one
         # BFS-grown partition. Labels are a pure function of the graph,
@@ -415,6 +438,14 @@ def main() -> int:
             f"/{graph.num_edges} ({edge_cut_pct}%) "
             f"({time.perf_counter() - t0:.1f}s)"
         )
+        if args.cache:
+            # Persist the delta/hub exchange's per-destination cut plan
+            # (exchange.cached_flood_plan) in the same npz under the
+            # same build fingerprint as the labels. The key must pin
+            # everything beyond the build that shapes the cut: the
+            # relabel (parts + seed) here, the node-shard count at the
+            # use site (solo and campaign meshes shard differently).
+            aux_base = (args.cache, fp, f"part{args.devices}_s{args.seed}")
 
     delays = lognormal_delays(
         graph, mean_ticks=2.0, sigma=0.6, max_ticks=args.delay_max_ticks,
@@ -422,7 +453,9 @@ def main() -> int:
     )
 
     if args.replicas:
-        return _campaign_rehearsal(args, graph, delays, devices, emit)
+        return _campaign_rehearsal(
+            args, graph, delays, devices, emit, aux_base
+        )
 
     # Host-fit arithmetic (shared by the auto-shrink preflight below and
     # the emitted rows): the virtual mesh concentrates every shard in ONE
@@ -502,10 +535,17 @@ def main() -> int:
                 chunk_size=args.chunkSize or None,
             )
 
+        aux_cache = (
+            (aux_base[0], aux_base[1],
+             f"floodcut{args.devices}_{aux_base[2]}")
+            if aux_base else None
+        )
+
         def run_mesh(ring_mode, exchange="dense", async_k=0):
             return run_sharded_flood_coverage(
                 graph, origins, args.horizon, mesh, ell_delays=delays,
                 block=args.block, ring_mode=ring_mode, exchange=exchange,
+                hub_rows=args.hub_rows or None, aux_cache=aux_cache,
                 **({"async_k": async_k} if async_k else {}),
                 **({"chunk_size": args.chunkSize} if args.chunkSize else {}),
             )
@@ -543,7 +583,8 @@ def main() -> int:
                 graph, sched, args.horizon, mesh, protocol=args.protocol,
                 fanout=args.fanout, ell_delays=delays, seed=args.seed,
                 record_coverage=True, ring_mode=ring_mode,
-                exchange=exchange, **chunk_kw,
+                exchange=exchange, hub_rows=args.hub_rows or None,
+                **chunk_kw,
             )
 
     cov_single = None
@@ -562,6 +603,8 @@ def main() -> int:
         legs.append(("sharded", "dense", 0))
     if args.exchange in ("delta", "ab"):
         legs.append(("sharded", "delta", 0))
+    if args.exchange in ("hub", "ab"):
+        legs.append(("sharded", "hub", 0))
     # Async legs ride the same transport(s) as the sync legs so the
     # sync-vs-async wall comparison is transport-for-transport.
     for k in async_ks:
@@ -569,6 +612,8 @@ def main() -> int:
             legs.append(("sharded", "async-dense", k))
         if args.exchange in ("delta", "ab"):
             legs.append(("sharded", "async-delta", k))
+        if args.exchange in ("hub", "ab"):
+            legs.append(("sharded", "async-hub", k))
 
     mesh_runs = []
     for ring_mode, exchange, async_k in legs:
@@ -655,7 +700,8 @@ def main() -> int:
                f" delta~{ex.get('achieved_delta_words_per_tick', 0):.1f}"
                f" words/tick (occ "
                f"{ex.get('delta_occupancy', 0):.3f})"
-               if ex is not None and ex.get("mode") == "delta" else ""))
+               if ex is not None and ex.get("mode") in ("delta", "hub")
+               else ""))
         emit(row)
 
     # Every pair of legs must agree — a check that costs nothing (all

@@ -152,7 +152,7 @@ ART_DIR = os.path.join(REPO, "docs", "artifacts")
 STAGE_ORDER = (
     "bench", "protocols", "kernel", "bench_rep2", "bench_rep3",
     "campaign", "staticcheck", "telemetry", "flightrec", "exchange",
-    "campaign_sharded", "async_ticks", "serve",
+    "exchange_hub", "campaign_sharded", "async_ticks", "serve",
     "scale1m", "scale1m_ba", "sweep250", "profile", "scale1m_full",
 )
 
@@ -165,7 +165,7 @@ STAGE_ORDER = (
 # probe sees such a mesh, so the first multi-chip window re-runs these
 # rows on hardware (ROADMAP: PR 11 exchange follow-up).
 PENDING_TPU_STAGES = (
-    "exchange", "campaign_sharded", "async_ticks", "serve",
+    "exchange", "exchange_hub", "campaign_sharded", "async_ticks", "serve",
 )
 
 
@@ -364,6 +364,20 @@ def stage_specs(args) -> dict:
                 "env": cpu,
                 "budget": args.stage_budget or 900,
             },
+            "exchange_hub": {
+                # Degree-split hub/tail transport at smoke shapes: the
+                # hub leg next to dense/delta, all bitwise-equal, with
+                # a forced 16-row hub set (the small ER graph is too
+                # flat for the modeled crossover to pick one).
+                "argv": [
+                    py, os.path.join(SCRIPTS, "mesh_rehearsal.py"),
+                    "--nodes", "2000", "--prob", "0.01", "--shares", "32",
+                    "--horizon", "24", "--chunkSize", "32",
+                    "--exchange", "ab", "--hub-rows", "16", "--partition",
+                ],
+                "env": cpu,
+                "budget": args.stage_budget or 900,
+            },
             "serve": {
                 # Continuous-batching server smoke: 12 mixed requests
                 # drained on the 8-virtual-device slot mesh, every
@@ -542,6 +556,25 @@ def stage_specs(args) -> dict:
                 "--topology", "ba", "--nodes", "100000", "--baM", "3",
                 "--shares", "64", "--horizon", "48", "--exchange", "ab",
                 "--partition", "--skip-parity",
+            ],
+            "env": sweep_env,
+            "budget": args.stage_budget or 3600,
+        },
+        "exchange_hub": {
+            # The degree-split hub/tail transport at rehearsal scale:
+            # BA 100K (a real scale-free degree profile, so the split
+            # threshold comes from the modeled word-count crossover,
+            # not a forced count) with dense + delta + hub legs plus
+            # async-hub K in {2, 4} composition, all bitwise-checked
+            # before any words/tick lands in a row. Host-mesh CPU by
+            # design (PENDING_TPU_STAGES note): wire-format crossover
+            # evidence, not a chip number; the record stays pending_tpu
+            # until a real multi-chip mesh is attached.
+            "argv": [
+                py, os.path.join(SCRIPTS, "mesh_rehearsal.py"),
+                "--topology", "ba", "--nodes", "100000", "--baM", "3",
+                "--shares", "64", "--horizon", "48", "--exchange", "hub",
+                "--async-k", "2,4", "--partition", "--skip-parity",
             ],
             "env": sweep_env,
             "budget": args.stage_budget or 3600,

@@ -99,7 +99,8 @@ def main() -> int:
                     help="one JSON line on stdout instead of the human report")
     ap.add_argument("--fixture",
                     choices=("f64", "recompile", "prng", "telemetry",
-                             "digest", "exchange", "meshfact", "async"),
+                             "digest", "exchange", "meshfact", "async",
+                             "hub"),
                     help="run one seeded regression fixture; exits non-zero "
                     "iff the analyzer (correctly) flags it")
     ap.add_argument("--lint-only", action="store_true",

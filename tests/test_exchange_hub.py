@@ -1,0 +1,400 @@
+"""Degree-split hub/tail transport (ISSUE 20): the host-side hub
+planners' split/cost contracts, destination-shard aggregation defaults,
+the cut-plan aux cache, and the headline invariant — ``exchange="hub"``
+bitwise-identical to dense across the flood runner, the partnered
+runner, and both factorized campaign runners, composed with async
+K in {1, 2, 4}, under churn + loss, and through the flight-recorder
+digest streams; plus the delta->dense overflow fallback under the
+factorized campaign runner."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import p2p_gossip_tpu as pg
+from p2p_gossip_tpu.batch.campaign import flood_replicas
+from p2p_gossip_tpu.batch.campaign_sharded import (
+    run_sharded_campaign,
+    run_sharded_protocol_campaign,
+)
+from p2p_gossip_tpu.engine.event import run_event_sim
+from p2p_gossip_tpu.models.latency import lognormal_delays
+from p2p_gossip_tpu.parallel import exchange as exch
+from p2p_gossip_tpu.parallel.engine_sharded import (
+    run_sharded_flood_coverage,
+    run_sharded_sim,
+)
+from p2p_gossip_tpu.parallel.mesh import make_mesh
+from p2p_gossip_tpu.parallel.protocols_sharded import (
+    run_sharded_partnered_sim,
+)
+
+
+def _cpu_mesh(n_node_shards, n_share_shards=1):
+    return make_mesh(n_node_shards, n_share_shards, devices=jax.devices("cpu"))
+
+
+def _campaign_mesh(n_node_shards, replicas):
+    devs = jax.devices("cpu")[: n_node_shards * replicas]
+    return make_mesh(n_node_shards, devices=devs, replicas=replicas)
+
+
+def _flood_need(g, k):
+    from p2p_gossip_tpu.parallel.mesh import pad_to_multiple
+
+    ell_idx, ell_mask = g.ell()
+    idx = pad_to_multiple(ell_idx, k)
+    msk = pad_to_multiple(ell_mask, k)
+    return exch.cached_flood_plan(idx, msk, k), idx.shape[0]
+
+
+# ---------------------------------------------------------------------------
+# Host-side planners: split structure, cost curve, aggregation default
+# ---------------------------------------------------------------------------
+
+def test_plan_hub_split_structure_and_tail_clearing():
+    g = pg.barabasi_albert(96, m=3, seed=1)
+    k = 4
+    (need, need_counts), n_padded = _flood_need(g, k)
+    n_loc, w = n_padded // k, 2
+    plan = exch.plan_hub_split(need, need_counts, k, n_loc, w, hub_rows=8)
+    assert plan["hub_count"] == 8
+    assert plan["hub_local"].shape == (k, 8)
+    assert plan["hub_global"].shape == (k, 8)
+    assert plan["hub_local"].dtype == np.int32
+    # Global ids are the local ids offset into each shard's block, all
+    # distinct (the overlay scatter writes disjoint rows).
+    expect = plan["hub_local"] + np.arange(k, dtype=np.int32)[:, None] * n_loc
+    assert np.array_equal(plan["hub_global"], expect)
+    assert len(np.unique(plan["hub_global"])) == k * 8
+    # Tail buffers never re-ship a hub row.
+    assert not plan["need_tail"][plan["hub_global"].reshape(-1)].any()
+    kept = np.ones(n_padded, dtype=bool)
+    kept[plan["hub_global"].reshape(-1)] = False
+    assert np.array_equal(plan["need_tail"][kept], need[kept])
+    assert plan["capacity"] % 8 == 0 and plan["capacity"] >= 8
+    rep = plan["report"]
+    assert rep["hub_rows_forced"] is True
+    assert rep["modeled_hub_words_per_tick"] == (
+        exch.modeled_exchange_words_per_tick(
+            "hub", n_shards=k, n_loc=n_loc, w=w,
+            capacity=plan["capacity"], hub_count=8,
+        )
+    )
+
+
+def test_plan_hub_split_ranks_by_fanout_and_clamps():
+    g = pg.barabasi_albert(96, m=3, seed=1)
+    k = 4
+    (need, need_counts), n_padded = _flood_need(g, k)
+    n_loc = n_padded // k
+    plan = exch.plan_hub_split(need, need_counts, k, n_loc, 2, hub_rows=8)
+    fan = need.sum(axis=1).reshape(k, n_loc)
+    for s in range(k):
+        hub_fans = fan[s][plan["hub_local"][s]]
+        tail = np.setdiff1d(np.arange(n_loc), plan["hub_local"][s])
+        assert hub_fans.min() >= fan[s][tail].max()
+    # A forced h beyond n_loc clamps; h=0 degenerates to pure delta.
+    big = exch.plan_hub_split(need, need_counts, k, n_loc, 2,
+                              hub_rows=10 * n_loc)
+    assert big["hub_count"] == n_loc
+    zero = exch.plan_hub_split(need, need_counts, k, n_loc, 2, hub_rows=0)
+    assert zero["hub_count"] == 0
+    assert np.array_equal(zero["need_tail"], need)
+
+
+def test_plan_partnered_hub_split_degree_ranked_and_honest():
+    rng = np.random.default_rng(3)
+    k, n_loc = 4, 24
+    degree = rng.integers(1, 40, k * n_loc).astype(np.int64)
+    plan = exch.plan_partnered_hub_split(degree, k, n_loc, 2, hub_rows=8)
+    assert plan["hub_count"] == 8
+    assert plan["need_tail"].shape == (k * n_loc, 1)
+    deg = degree.reshape(k, n_loc)
+    for s in range(k):
+        hub_degs = deg[s][plan["hub_local"][s]]
+        tail = np.setdiff1d(np.arange(n_loc), plan["hub_local"][s])
+        assert hub_degs.min() >= deg[s][tail].max()
+    # The uniform-tail cost curve is honest: on shapes where the tail
+    # capacity is clamped below (n_loc - h) * w anyway, shrinking the
+    # tail buys nothing and the search keeps h = 0 (pure delta).
+    auto = exch.plan_partnered_hub_split(degree, k, n_loc, 2)
+    assert auto["report"]["hub_rows_forced"] is False
+    assert auto["hub_count"] in (0, auto["report"]["crossover_h"] or 0) or (
+        auto["report"]["modeled_hub_words_per_tick"]
+        <= auto["report"]["modeled_delta_words_per_tick"]
+    )
+
+
+def test_choose_aggregate_and_pack_model():
+    # One flat 1-D scatter address word per slot vs the dual-index 2-D
+    # scatter's two — aggregation is modeled strictly cheaper at every
+    # real shape, which is exactly why it is the engines' default.
+    assert exch.modeled_pack_index_words(4, 16, True) == 4 * 17
+    assert exch.modeled_pack_index_words(4, 16, False) == 2 * 4 * 17
+    for n_dests in (1, 3, 8):
+        for cap in (8, 240, 4096):
+            assert exch.choose_aggregate(n_dests, cap)
+
+
+def test_hub_model_value_pin():
+    # The shared wire model the engines' extra["exchange"] reports are
+    # checked against: (k-1) peers x (hub block + 2-words-per-entry
+    # tail), delay-count independent.
+    assert exch.modeled_exchange_words_per_tick(
+        "hub", n_shards=8, n_loc=12500, w=2, capacity=224, hub_count=16,
+    ) == 7 * (16 * 2 + 2 * 224)
+    # h = 0 degenerates to the delta model.
+    assert exch.modeled_exchange_words_per_tick(
+        "hub", n_shards=4, n_loc=100, w=2, capacity=64, hub_count=0,
+    ) == exch.modeled_exchange_words_per_tick(
+        "delta", n_shards=4, n_loc=100, w=2, capacity=64,
+    )
+
+
+def test_cached_flood_plan_persists_and_reloads(tmp_path):
+    from p2p_gossip_tpu.models.topology import (
+        load_graph_cache_aux,
+        save_graph_cache,
+    )
+
+    g = pg.erdos_renyi(64, 0.1, seed=5)
+    k = 4
+    ell_idx, ell_mask = g.ell()
+    path = str(tmp_path / "g.npz")
+    save_graph_cache(path, g, "fp-hub-test")
+    direct = exch.cached_flood_plan(ell_idx, ell_mask, k)
+    cached = exch.cached_flood_plan(
+        ell_idx, ell_mask, k, aux_cache=(path, "fp-hub-test", "floodcut4")
+    )
+    assert np.array_equal(direct[0], cached[0])
+    assert np.array_equal(direct[1], cached[1])
+    # The scan persisted under the key and round-trips.
+    stored = load_graph_cache_aux(path)
+    assert "floodcut4" in stored
+    assert np.array_equal(stored["floodcut4"].astype(bool), direct[0])
+    again = exch.cached_flood_plan(
+        ell_idx, ell_mask, k, aux_cache=(path, "fp-hub-test", "floodcut4")
+    )
+    assert np.array_equal(again[0], direct[0])
+
+
+# ---------------------------------------------------------------------------
+# Parity matrix: hub x {flood, partnered, campaigns} x async K
+# ---------------------------------------------------------------------------
+
+def test_hub_parity_flood_solo():
+    g = pg.barabasi_albert(96, m=3, seed=11)
+    sched = pg.uniform_renewal_schedule(96, sim_time=3.0, tick_dt=0.01,
+                                        seed=11)
+    dense = run_sharded_sim(g, sched, 300, _cpu_mesh(4, 2), chunk_size=32,
+                            ring_mode="sharded")
+    hub = run_sharded_sim(g, sched, 300, _cpu_mesh(4, 2), chunk_size=32,
+                          exchange="hub", hub_rows=8)
+    assert hub.equal_counts(dense)
+    assert np.array_equal(hub.received, dense.received)
+    ex = hub.extra["exchange"]
+    assert ex["mode"] == "hub" and ex["hub_count"] == 8
+    assert ex["hub_rows_forced"] is True
+    assert ex["achieved_delta_words_per_tick"] > 0
+
+
+def test_hub_auto_split_degenerates_honestly():
+    """Without a forced hub_rows the tiny flat graph picks h = 0 and the
+    run degenerates to plain delta — still bitwise dense."""
+    g = pg.erdos_renyi(64, 0.1, seed=21)
+    sched = pg.uniform_renewal_schedule(64, sim_time=3.0, tick_dt=0.01,
+                                        seed=21)
+    dense = run_sharded_sim(g, sched, 300, _cpu_mesh(4, 2), chunk_size=32,
+                            ring_mode="sharded")
+    auto = run_sharded_sim(g, sched, 300, _cpu_mesh(4, 2), chunk_size=32,
+                           exchange="hub")
+    assert auto.equal_counts(dense)
+    ex = auto.extra["exchange"]
+    assert ex["mode"] in ("delta", "hub")
+    if ex["mode"] == "delta":
+        assert ex.get("hub_count", 0) == 0
+
+
+@pytest.mark.parametrize("k_async", [1, 2, 4])
+def test_hub_parity_flood_async(k_async):
+    """async-hub == async-dense at the same K, tick for tick: both sit
+    on the same clamped-delay program, so the hub transport must not
+    perturb the K-ahead frontier."""
+    g = pg.barabasi_albert(96, m=3, seed=23)
+    d = lognormal_delays(g, mean_ticks=2.0, sigma=0.5, max_ticks=4, seed=23)
+    sched = pg.uniform_renewal_schedule(96, sim_time=3.0, tick_dt=0.01,
+                                        seed=23)
+    kw = dict(ell_delays=d, chunk_size=32, async_k=k_async)
+    ref = run_sharded_sim(g, sched, 300, _cpu_mesh(4, 2),
+                          exchange="async-dense", **kw)
+    hub = run_sharded_sim(g, sched, 300, _cpu_mesh(4, 2),
+                          exchange="async-hub", hub_rows=8, **kw)
+    assert hub.equal_counts(ref), k_async
+    assert np.array_equal(hub.received, ref.received)
+    ex = hub.extra["exchange"]
+    assert ex["mode"] == "hub" and ex["async_k"] == k_async
+
+
+def test_hub_parity_partnered():
+    from p2p_gossip_tpu.models.protocols import run_pushpull_sim
+
+    g = pg.erdos_renyi(60, 0.1, seed=13)
+    sched = pg.uniform_renewal_schedule(60, sim_time=3.0, tick_dt=0.01,
+                                        seed=13)
+    for loss in (None, pg.LinkLossModel(0.2, seed=6)):
+        solo, _ = run_pushpull_sim(g, sched, 300, seed=2, loss=loss)
+        hub = run_sharded_partnered_sim(
+            g, sched, 300, _cpu_mesh(2, 2), protocol="pushpull", seed=2,
+            chunk_size=32, loss=loss, exchange="hub", hub_rows=8,
+        )
+        assert hub.equal_counts(solo), loss
+        ex = hub.extra["exchange"]
+        assert ex["mode"] == "hub" and ex["hub_count"] == 8
+
+
+def test_hub_parity_campaign_flood():
+    g = pg.barabasi_albert(96, m=3, seed=31)
+    reps = flood_replicas(g, 6, [0, 1, 2, 3], 24)
+    camp = run_sharded_campaign(
+        g, reps, 24, _campaign_mesh(4, 2), exchange="hub", hub_rows=8,
+    )
+    assert camp.extra["exchange"]["mode"] == "hub"
+    for r in range(4):
+        solo = run_sharded_sim(
+            g, reps.replica_schedule(r, 24), 24, _cpu_mesh(4),
+            chunk_size=reps.shares_per_replica, exchange="hub", hub_rows=8,
+        )
+        assert np.array_equal(solo.received[: g.n], camp.received[r]), r
+        assert np.array_equal(solo.sent[: g.n], camp.sent[r]), r
+
+
+@pytest.mark.parametrize("k_async", [2, 4])
+def test_hub_parity_campaign_flood_async(k_async):
+    g = pg.barabasi_albert(96, m=3, seed=33)
+    d = lognormal_delays(g, mean_ticks=2.0, sigma=0.5, max_ticks=4, seed=33)
+    reps = flood_replicas(g, 6, [0, 1], 24)
+    camp = run_sharded_campaign(
+        g, reps, 24, _campaign_mesh(4, 2), ell_delays=d,
+        exchange="async-hub", async_k=k_async, hub_rows=8,
+    )
+    for r in range(2):
+        solo = run_sharded_sim(
+            g, reps.replica_schedule(r, 24), 24, _cpu_mesh(4),
+            ell_delays=d, chunk_size=reps.shares_per_replica,
+            exchange="async-hub", async_k=k_async, hub_rows=8,
+        )
+        assert np.array_equal(solo.received[: g.n], camp.received[r]), r
+
+
+def test_hub_parity_campaign_partnered():
+    g = pg.barabasi_albert(96, m=3, seed=35)
+    reps = flood_replicas(g, 6, [0, 1, 2, 3], 24)
+    camp = run_sharded_protocol_campaign(
+        g, reps, 24, _campaign_mesh(2, 2), protocol="pushpull",
+        exchange="hub", hub_rows=8,
+    )
+    assert camp.extra["exchange"]["mode"] == "hub"
+    for r in range(4):
+        solo = run_sharded_partnered_sim(
+            g, reps.replica_schedule(r, 24), 24, _cpu_mesh(2),
+            protocol="pushpull", seed=int(reps.seeds[r]) & 0xFFFFFFFF,
+            chunk_size=reps.shares_per_replica, exchange="hub", hub_rows=8,
+        )
+        assert np.array_equal(solo.received[: g.n], camp.received[r]), r
+        assert np.array_equal(solo.sent[: g.n], camp.sent[r]), r
+
+
+def test_hub_parity_multi_delay_churn_loss():
+    """The full-hazard cell: per-edge delays, link loss, and churn —
+    hub must still match dense AND the event oracle."""
+    g = pg.erdos_renyi(64, 0.1, seed=9)
+    d = lognormal_delays(g, mean_ticks=2.0, sigma=0.6, max_ticks=4, seed=9)
+    sched = pg.uniform_renewal_schedule(64, sim_time=5.0, tick_dt=0.01,
+                                        seed=9)
+    loss = pg.LinkLossModel(0.25, seed=4)
+    churn = pg.random_churn(64, 500, outage_prob=0.3, mean_down_ticks=40,
+                            seed=5)
+    ev = run_event_sim(g, sched, 500, ell_delays=d, loss=loss, churn=churn)
+    hub = run_sharded_sim(g, sched, 500, _cpu_mesh(4, 2), ell_delays=d,
+                          chunk_size=32, loss=loss, churn=churn,
+                          exchange="hub", hub_rows=8)
+    assert hub.equal_counts(ev)
+    assert hub.extra["exchange"]["mode"] == "hub"
+    assert hub.extra["ring"]["delay_splits"] > 1
+
+
+def test_hub_digest_streams_match_dense():
+    """Flight-recorder view of the invariant: per-tick state digests of
+    a dense and a hub run must be identical — the contract
+    scripts/divergence.py --pair sync-hub bisects against."""
+    import tempfile
+
+    from p2p_gossip_tpu import telemetry
+    from p2p_gossip_tpu.telemetry import compare
+
+    g = pg.erdos_renyi(48, 0.12, seed=15)
+    sched = pg.uniform_renewal_schedule(48, sim_time=4.0, tick_dt=0.01,
+                                        seed=15)
+    assert sched.num_shares > 0
+
+    def capture(tmp, **kw):
+        telemetry.configure(str(tmp), rings=True)
+        try:
+            run_sharded_sim(g, sched, 400, _cpu_mesh(2, 2), chunk_size=32,
+                            **kw)
+        finally:
+            telemetry.close()
+        events = list(telemetry.events())
+        telemetry.reset()
+        return compare.select_stream(
+            compare.digest_streams(events), kernel="engine_sharded", shard=0
+        )
+
+    with tempfile.TemporaryDirectory() as td:
+        dense = capture(td + "/dense.jsonl", ring_mode="sharded")
+        hub = capture(td + "/hub.jsonl", exchange="hub", hub_rows=8)
+    assert dense and dense == hub
+    div = compare.first_divergence(dense, hub)
+    assert not div.diverged and div.compared == len(dense)
+
+
+def test_campaign_delta_overflow_falls_back_dense():
+    """Factorized campaign runner on a graph dense enough that the
+    fixed-capacity tail buffers overflow: the dense fallback must fire
+    (the counters say so) and every replica stays bitwise its solo
+    run."""
+    g = pg.erdos_renyi(48, 0.3, seed=3)  # dense: cut >> capacity floor
+    reps = flood_replicas(g, 4, [0, 1], 40)
+    camp = run_sharded_campaign(
+        g, reps, 40, _campaign_mesh(4, 2), exchange="delta",
+        record_coverage=True,
+    )
+    ex = camp.extra["exchange"]
+    assert ex["overflow_write_ticks"] > 0, ex
+    assert ex["dense_fallback_reads"] > 0, ex
+    for r in range(2):
+        st, cov = run_sharded_flood_coverage(
+            g, np.asarray(reps.replica_schedule(r, 40).origins), 40,
+            _cpu_mesh(4), chunk_size=reps.shares_per_replica,
+            exchange="delta",
+        )
+        assert np.array_equal(st.received[: g.n], camp.received[r]), r
+
+
+def test_hub_aggregation_recorded_and_word_model_agrees():
+    """Satellite 2's contract: the drivers pick aggregate=True whenever
+    the modeled aggregated pack wins (always, per the model) and record
+    it; achieved words/tick on overflow-free runs equals the model."""
+    g = pg.watts_strogatz(96, k=4, beta=0.05, seed=17)
+    sched = pg.uniform_renewal_schedule(96, sim_time=3.0, tick_dt=0.01,
+                                        seed=17)
+    hub = run_sharded_sim(g, sched, 300, _cpu_mesh(4, 2), chunk_size=32,
+                          exchange="hub", hub_rows=8)
+    ex = hub.extra["exchange"]
+    assert ex["aggregated"] is True
+    if ex["overflow_write_ticks"] == 0:
+        assert ex["achieved_delta_words_per_tick"] == pytest.approx(
+            ex["modeled_hub_words_per_tick"]
+        )
